@@ -1,0 +1,21 @@
+"""raft_tpu — a TPU-native massively-batched Raft consensus framework.
+
+Two backends behind one deterministic tick contract (see DESIGN.md):
+
+- ``raft_tpu.core``: the CPU reference path — classical ``Node`` /
+  ``Transport`` / ``Cluster`` objects, one group at a time. Ground truth.
+- ``raft_tpu.sim``: the TPU batched path — a pure ``step`` function over a
+  struct-of-arrays state for ``[n_groups, k]`` replicas, vmapped/jitted/
+  scanned, sharded over a device mesh (``raft_tpu.parallel``). See the
+  module's own docs for availability of each piece.
+
+Reference parity note: the upstream reference (qzwsq/raft, expected at
+/root/reference) was empty at survey and build time — see SURVEY.md. The
+behavior contract implemented here is the driver-confirmed north star in
+BASELINE.json plus the canonical Raft specification.
+"""
+
+from raft_tpu.config import RaftConfig
+
+__all__ = ["RaftConfig"]
+__version__ = "0.1.0"

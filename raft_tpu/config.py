@@ -1,0 +1,79 @@
+"""Configuration shared by the CPU reference path and the TPU batched path.
+
+Every knob that affects semantics lives here so the two backends cannot
+drift. Probabilities are expressed as floats in [0, 1] and converted to
+uint32 thresholds (`*_u32`) so that the CPU path (python ints) and the TPU
+path (uint32 lanes) make bit-identical decisions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+_U32 = 0xFFFFFFFF
+
+
+def _prob_to_u32(p: float) -> int:
+    """Map a probability to a uint32 threshold: event iff hash < threshold.
+
+    Probabilities are quantized to k/2**32 with k <= 2**32 - 1, so p=1.0
+    means 1 - 2**-32 — the threshold must itself fit in a uint32 lane or the
+    CPU and TPU paths could disagree on hash == 0xFFFFFFFF.
+    """
+    if p <= 0.0:
+        return 0
+    return min(int(p * 4294967296.0), _U32)
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftConfig:
+    """Semantic parameters of the simulated Raft universe (see DESIGN.md §2)."""
+
+    n_groups: int = 1          # G — independent Raft groups (batch axis)
+    k: int = 5                 # K — replicas per group
+    log_cap: int = 32          # L — ring window: last_index - snap_index <= L
+    max_entries_per_msg: int = 4   # E — entries carried per AppendEntries
+    heartbeat_every: int = 2   # leader AE cadence, in ticks
+    election_min: int = 10     # randomized election timeout in
+    election_range: int = 10   # [election_min, election_min + election_range)
+    compact_every: int = 8     # snapshot when commit - snap_index >= this
+    cmds_per_tick: int = 1     # client commands the leader appends per tick
+    seed: int = 0
+
+    # Fault injection (DESIGN.md §4). All off by default.
+    drop_prob: float = 0.0       # per-link per-tick message loss
+    crash_prob: float = 0.0      # per-node per-epoch crash probability
+    crash_epoch: int = 64        # ticks per crash epoch
+    partition_prob: float = 0.0  # per-group per-epoch partition probability
+    partition_epoch: int = 64    # ticks per partition epoch
+
+    def __post_init__(self):
+        assert self.k >= 1
+        assert self.election_range >= 1
+        assert self.heartbeat_every >= 1
+        assert self.max_entries_per_msg >= 1
+        # The window must fit a burst of appends plus compaction slack.
+        assert self.log_cap >= self.compact_every + self.cmds_per_tick + 1, (
+            "log_cap must cover compact_every + cmds_per_tick + 1 or the "
+            "window can deadlock before compaction frees space"
+        )
+        assert self.election_min > 2 * self.heartbeat_every, (
+            "election timeout must comfortably exceed the heartbeat cadence "
+            "or steady-state leadership is impossible"
+        )
+
+    @property
+    def majority(self) -> int:
+        return self.k // 2 + 1
+
+    @property
+    def drop_u32(self) -> int:
+        return _prob_to_u32(self.drop_prob)
+
+    @property
+    def crash_u32(self) -> int:
+        return _prob_to_u32(self.crash_prob)
+
+    @property
+    def partition_u32(self) -> int:
+        return _prob_to_u32(self.partition_prob)

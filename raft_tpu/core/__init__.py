@@ -1,0 +1,13 @@
+"""CPU reference path: classical Node / Transport / Cluster Raft.
+
+This is the ground-truth oracle (SURVEY.md §7 step 1): a readable,
+object-style single-group-at-a-time Raft implementation whose per-tick
+semantics are specified in DESIGN.md §2 and mirrored bit-for-bit by the
+batched TPU path in raft_tpu.sim.
+"""
+
+from raft_tpu.core.node import Node
+from raft_tpu.core.transport import Transport
+from raft_tpu.core.cluster import Cluster
+
+__all__ = ["Node", "Transport", "Cluster"]

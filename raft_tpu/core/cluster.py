@@ -1,0 +1,147 @@
+"""Cluster harness: builds one Raft group, drives ticks, checks invariants.
+
+Also the trace source for the CPU↔TPU differential test: `snapshot()`
+captures exactly the per-node fields the batched state carries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.node import Node, LEADER
+from raft_tpu.core.transport import Transport
+from raft_tpu.utils import rng
+
+
+@dataclasses.dataclass
+class NodeView:
+    """Per-node observable state after phase A of a tick."""
+    term: int
+    role: int
+    voted_for: int
+    leader_id: int
+    last_index: int
+    commit: int
+    applied: int
+    digest: int
+    snap_index: int
+    snap_term: int
+    alive: bool
+
+
+class SafetyViolation(AssertionError):
+    pass
+
+
+class Cluster:
+    def __init__(self, cfg: RaftConfig, group: int = 0,
+                 check_invariants: bool = True):
+        self.cfg = cfg
+        self.g = group
+        self.check = check_invariants
+        self.transport = Transport(cfg, group)
+        self.nodes = [Node(cfg, group, i, self.transport, self._on_apply)
+                      for i in range(cfg.k)]
+        self.tick_count = 0
+        self.alive_prev = [True] * cfg.k
+        # Test hook: (tick) -> List[bool] overriding the hash-based crash
+        # schedule. Instance attribute (like Transport.link_filter) so one
+        # test's schedule can never leak into another cluster.
+        self.alive_fn = None
+        # Safety bookkeeping.
+        self._leaders_by_term: Dict[int, int] = {}
+        # index -> payload. Identity of a committed entry is (index, payload):
+        # the term of an entry may legitimately be rewritten by a leader
+        # takeover re-proposal (DESIGN.md §2a) without changing the entry.
+        self._committed: Dict[int, int] = {}
+        self.total_applies = 0
+
+    # ---------------------------------------------------------------- faults
+
+    def alive(self, tick: int) -> List[bool]:
+        if self.alive_fn is not None:
+            return list(self.alive_fn(tick))
+        cfg = self.cfg
+        return [rng.node_alive(cfg.seed, self.g, i, tick,
+                               cfg.crash_u32, cfg.crash_epoch)
+                for i in range(cfg.k)]
+
+    # ------------------------------------------------------------ invariants
+
+    def _on_apply(self, node_id: int, index: int, term: int, payload: int):
+        self.total_applies += 1
+        if not self.check:
+            return
+        prev = self._committed.get(index)
+        if prev is None:
+            self._committed[index] = payload
+        elif prev != payload:
+            raise SafetyViolation(
+                f"group {self.g}: node {node_id} applied payload {payload} at "
+                f"index {index}, but {prev} was already applied there")
+
+    def _check_election_safety(self):
+        # Scans ALL nodes, crashed included: a crashed leader still "holds"
+        # its term — no other leader may ever exist for it.
+        for n in self.nodes:
+            if n.role == LEADER:
+                prev = self._leaders_by_term.get(n.term)
+                if prev is None:
+                    self._leaders_by_term[n.term] = n.id
+                elif prev != n.id:
+                    raise SafetyViolation(
+                        f"group {self.g}: two leaders in term {n.term}: "
+                        f"{prev} and {n.id}")
+
+    # ------------------------------------------------------------------ tick
+
+    def tick(self):
+        t = self.tick_count
+        alive_now = self.alive(t)
+        for i, n in enumerate(self.nodes):
+            if alive_now[i] and not self.alive_prev[i]:
+                n.restart()
+        inboxes = self.transport.deliver(t, alive_now)
+        for i, n in enumerate(self.nodes):
+            if alive_now[i]:
+                n.phase_d(inboxes[i])
+        for i, n in enumerate(self.nodes):
+            if alive_now[i]:
+                n.phase_t()
+        for i, n in enumerate(self.nodes):
+            if alive_now[i]:
+                n.phase_c()
+        for i, n in enumerate(self.nodes):
+            if alive_now[i]:
+                n.phase_a()
+        # Crashed nodes sent nothing; anything they had queued pre-crash was
+        # already in flight and still delivers.
+        if self.check:
+            self._check_election_safety()
+        self.alive_prev = alive_now
+        self.tick_count += 1
+
+    def run(self, ticks: int):
+        for _ in range(ticks):
+            self.tick()
+
+    # ------------------------------------------------------------- observers
+
+    def leader(self) -> Optional[int]:
+        """Current unique alive leader of the highest term, if any."""
+        best = None
+        for i, n in enumerate(self.nodes):
+            if n.role == LEADER and self.alive_prev[i]:
+                if best is None or n.term > self.nodes[best].term:
+                    best = i
+        return best
+
+    def snapshot(self) -> List[NodeView]:
+        return [NodeView(term=n.term, role=n.role, voted_for=n.voted_for,
+                         leader_id=n.leader_id, last_index=n.last_index,
+                         commit=n.commit, applied=n.applied, digest=n.digest,
+                         snap_index=n.snap_index, snap_term=n.snap_term,
+                         alive=self.alive_prev[i])
+                for i, n in enumerate(self.nodes)]

@@ -1,0 +1,379 @@
+"""A single Raft replica — the CPU reference implementation.
+
+Implements the tick contract of DESIGN.md §2 exactly: phase D (process
+inbox in canonical order), phase T (timers/roles), phase C (client
+appends), phase A (commit advance / apply / compact). The TPU path
+(raft_tpu/sim/step.py, built against this oracle) mirrors every branch in
+here; any semantic change must be made in both backends together, and the
+differential suite comparing their traces must stay green.
+
+Log model (DESIGN.md §3): `self.log` holds entries for absolute indices
+(snap_index, last_index], window-bounded by `log_cap`; the prefix up to
+snap_index lives only as (snap_index, snap_term, snap_digest).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core import rpc
+from raft_tpu.utils import rng
+
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+NO_VOTE = -1
+
+
+class Node:
+    def __init__(self, cfg: RaftConfig, group: int, node_id: int, transport,
+                 on_apply: Optional[Callable[[int, int, int, int], None]] = None):
+        self.cfg = cfg
+        self.g = group
+        self.id = node_id
+        self.transport = transport
+        self.on_apply = on_apply  # (node_id, index, term, payload)
+
+        # Durable state (survives crash/restart).
+        self.term = 0
+        self.voted_for = NO_VOTE
+        self.log: List[tuple] = []   # [(term, payload)] for (snap_index, last_index]
+        self.snap_index = 0
+        self.snap_term = 0
+        self.snap_digest = 0
+        self.rng_draws = 0           # monotone deadline-draw counter
+
+        # Volatile state (reset on restart).
+        self.role = FOLLOWER
+        self.leader_id = NO_VOTE
+        self.commit = 0
+        self.applied = 0
+        self.digest = 0
+        self.votes = [False] * cfg.k
+        self.next_index = [1] * cfg.k
+        self.match_index = [0] * cfg.k
+        self.election_elapsed = 0
+        self.heartbeat_elapsed = 0
+        self.deadline = 0
+        self._reset_election_timer()
+
+    # ------------------------------------------------------------- log helpers
+
+    @property
+    def last_index(self) -> int:
+        return self.snap_index + len(self.log)
+
+    def term_at(self, idx: int) -> int:
+        if idx == self.snap_index:
+            return self.snap_term
+        assert self.snap_index < idx <= self.last_index, (idx, self.snap_index)
+        return self.log[idx - self.snap_index - 1][0]
+
+    def payload_at(self, idx: int) -> int:
+        assert self.snap_index < idx <= self.last_index
+        return self.log[idx - self.snap_index - 1][1]
+
+    def last_log_term(self) -> int:
+        return self.term_at(self.last_index)
+
+    def _window_has_room(self, n: int = 1) -> bool:
+        return self.last_index + n - self.snap_index <= self.cfg.log_cap
+
+    def _append(self, term: int, payload: int) -> bool:
+        if not self._window_has_room(1):
+            return False
+        self.log.append((term, payload))
+        return True
+
+    # ------------------------------------------------------------ transitions
+
+    def _reset_election_timer(self):
+        self.election_elapsed = 0
+        self.deadline = rng.election_deadline(
+            self.cfg.seed, self.g, self.id, self.rng_draws,
+            self.cfg.election_min, self.cfg.election_range)
+        self.rng_draws += 1
+
+    def _step_down(self, new_term: int):
+        """Observed a higher term: adopt it, become follower. No timer reset."""
+        self.term = new_term
+        self.role = FOLLOWER
+        self.voted_for = NO_VOTE
+        self.leader_id = NO_VOTE
+        self.votes = [False] * self.cfg.k
+
+    def _become_leader(self):
+        self.role = LEADER
+        self.leader_id = self.id
+        self.next_index = [self.last_index + 1] * self.cfg.k
+        self.match_index = [0] * self.cfg.k
+        # Fire the initial heartbeat in phase T of this same tick.
+        self.heartbeat_elapsed = self.cfg.heartbeat_every
+        # Paxos-style takeover (DESIGN.md §2a): re-propose the uncommitted
+        # suffix under the new term, in place. Unlike the common "append a
+        # no-op" idiom this cannot grow the log, so it stays live under the
+        # bounded window: a full window of prior-term entries would otherwise
+        # wedge the group forever (§5.4.2 forbids counting prior-term
+        # replicas, and with no room for a current-term entry, commit — and
+        # hence compaction — could never advance).
+        for i in range(self.commit + 1, self.last_index + 1):
+            pos = i - self.snap_index - 1
+            self.log[pos] = (self.term, self.log[pos][1])
+
+    def _start_election(self):
+        self.term += 1
+        self.role = CANDIDATE
+        self.voted_for = self.id
+        self.leader_id = NO_VOTE
+        self.votes = [i == self.id for i in range(self.cfg.k)]
+        self._reset_election_timer()
+        if self.cfg.majority == 1:
+            self._become_leader()
+            return
+        for p in range(self.cfg.k):
+            if p != self.id:
+                self.transport.send(rpc.RequestVoteReq(
+                    rpc.RV_REQ, self.id, p, term=self.term,
+                    last_log_index=self.last_index,
+                    last_log_term=self.last_log_term()))
+
+    def restart(self):
+        """Dead→alive edge: durable state survives, volatile state resets."""
+        self.role = FOLLOWER
+        self.leader_id = NO_VOTE
+        self.commit = self.snap_index
+        self.applied = self.snap_index
+        self.digest = self.snap_digest
+        self.votes = [False] * self.cfg.k
+        self.next_index = [1] * self.cfg.k
+        self.match_index = [0] * self.cfg.k
+        self.heartbeat_elapsed = 0
+        self._reset_election_timer()
+
+    # ---------------------------------------------------------------- phase D
+
+    def phase_d(self, inbox: List[rpc.Msg]):
+        for m in rpc.sort_inbox(inbox):
+            if m.type == rpc.RV_REQ:
+                self._on_rv_req(m)
+            elif m.type == rpc.RV_RESP:
+                self._on_rv_resp(m)
+            elif m.type == rpc.AE_REQ:
+                self._on_ae_req(m)
+            elif m.type == rpc.AE_RESP:
+                self._on_ae_resp(m)
+            elif m.type == rpc.IS_REQ:
+                self._on_is_req(m)
+            elif m.type == rpc.IS_RESP:
+                self._on_is_resp(m)
+
+    def _on_rv_req(self, m: rpc.RequestVoteReq):
+        if m.term > self.term:
+            self._step_down(m.term)
+        log_ok = (m.last_log_term > self.last_log_term()
+                  or (m.last_log_term == self.last_log_term()
+                      and m.last_log_index >= self.last_index))
+        grant = (m.term == self.term
+                 and self.voted_for in (NO_VOTE, m.src)
+                 and log_ok)
+        if grant:
+            self.voted_for = m.src
+            self._reset_election_timer()
+        self.transport.send(rpc.RequestVoteResp(
+            rpc.RV_RESP, self.id, m.src, term=self.term, granted=grant))
+
+    def _on_rv_resp(self, m: rpc.RequestVoteResp):
+        if m.term > self.term:
+            self._step_down(m.term)
+            return
+        if self.role != CANDIDATE or m.term != self.term or not m.granted:
+            return
+        self.votes[m.src] = True
+        if sum(self.votes) >= self.cfg.majority:
+            self._become_leader()
+
+    def _accept_leader(self, m):
+        """Common prelude of AE/IS from the current-term leader."""
+        self.role = FOLLOWER
+        self.leader_id = m.src
+        self.votes = [False] * self.cfg.k
+        self._reset_election_timer()
+
+    def _on_ae_req(self, m: rpc.AppendEntriesReq):
+        if m.term > self.term:
+            self._step_down(m.term)
+        if m.term < self.term:
+            self.transport.send(rpc.AppendEntriesResp(
+                rpc.AE_RESP, self.id, m.src, term=self.term,
+                success=False, match=0))
+            return
+        self._accept_leader(m)
+
+        prev = m.prev_index
+        if prev > self.last_index:
+            # Past our end: tell the leader where our log actually ends.
+            self.transport.send(rpc.AppendEntriesResp(
+                rpc.AE_RESP, self.id, m.src, term=self.term,
+                success=False, match=self.last_index + 1))
+            return
+        if prev >= self.snap_index and self.term_at(prev) != m.prev_term:
+            # Conflict fast-backup: first index of the conflicting term.
+            ct = self.term_at(prev)
+            ci = prev
+            while ci - 1 > self.snap_index and self.term_at(ci - 1) == ct:
+                ci -= 1
+            self.transport.send(rpc.AppendEntriesResp(
+                rpc.AE_RESP, self.id, m.src, term=self.term,
+                success=False, match=ci))
+            return
+
+        # Entries with index <= snap_index are committed here, hence match by
+        # the Log Matching property — skip them.
+        j0 = max(0, self.snap_index - prev)
+        hi = prev + j0
+        for j in range(j0, len(m.entries)):
+            idx = prev + 1 + j
+            et, ep = m.entries[j]
+            if idx <= self.last_index:
+                if self.term_at(idx) == et:
+                    hi = idx
+                    continue
+                if self.payload_at(idx) == ep:
+                    # Same entry re-proposed under a newer term (leader
+                    # takeover, DESIGN.md §2a): overwrite the term in place
+                    # and keep the tail. Needs no window room — this is what
+                    # keeps takeover live when the window is full.
+                    self.log[idx - self.snap_index - 1] = (et, ep)
+                    hi = idx
+                    continue
+                # Divergent suffix: truncate it (never reaches committed
+                # entries: a committed entry's payload is what the leader
+                # itself holds at that index, so a differing payload proves
+                # the entry was never committed).
+                assert idx > self.commit, "refusing to truncate committed entries"
+                del self.log[idx - self.snap_index - 1:]
+            if not self._append(et, ep):
+                break  # window full — flow control; leader will resend
+            hi = idx
+        if m.leader_commit > self.commit:
+            # Only up to `hi`: beyond it our suffix is not known to match.
+            self.commit = max(self.commit, min(m.leader_commit, hi))
+        self.transport.send(rpc.AppendEntriesResp(
+            rpc.AE_RESP, self.id, m.src, term=self.term, success=True, match=hi))
+
+    def _on_ae_resp(self, m: rpc.AppendEntriesResp):
+        if m.term > self.term:
+            self._step_down(m.term)
+            return
+        if self.role != LEADER or m.term != self.term:
+            return
+        if m.success:
+            self.match_index[m.src] = max(self.match_index[m.src], m.match)
+            self.next_index[m.src] = self.match_index[m.src] + 1
+        else:
+            self.next_index[m.src] = max(1, min(self.next_index[m.src] - 1, m.match))
+
+    def _on_is_req(self, m: rpc.InstallSnapshotReq):
+        if m.term > self.term:
+            self._step_down(m.term)
+        if m.term < self.term:
+            self.transport.send(rpc.InstallSnapshotResp(
+                rpc.IS_RESP, self.id, m.src, term=self.term, match=0))
+            return
+        self._accept_leader(m)
+        if m.snap_index <= self.commit:
+            # Already have everything the snapshot covers.
+            self.transport.send(rpc.InstallSnapshotResp(
+                rpc.IS_RESP, self.id, m.src, term=self.term, match=self.commit))
+            return
+        if (m.snap_index <= self.last_index
+                and self.term_at(max(m.snap_index, self.snap_index)) == m.snap_term
+                and m.snap_index >= self.snap_index):
+            # Snapshot point exists in our log with the same term: keep the
+            # suffix after it (Raft §7), drop the prefix.
+            self.log = self.log[m.snap_index - self.snap_index:]
+        else:
+            self.log = []
+        self.snap_index = m.snap_index
+        self.snap_term = m.snap_term
+        self.snap_digest = m.snap_digest
+        self.commit = m.snap_index
+        self.applied = m.snap_index
+        self.digest = m.snap_digest
+        self.transport.send(rpc.InstallSnapshotResp(
+            rpc.IS_RESP, self.id, m.src, term=self.term, match=m.snap_index))
+
+    def _on_is_resp(self, m: rpc.InstallSnapshotResp):
+        if m.term > self.term:
+            self._step_down(m.term)
+            return
+        if self.role != LEADER or m.term != self.term:
+            return
+        self.match_index[m.src] = max(self.match_index[m.src], m.match)
+        self.next_index[m.src] = self.match_index[m.src] + 1
+
+    # ---------------------------------------------------------------- phase T
+
+    def phase_t(self):
+        if self.role == LEADER:
+            self.heartbeat_elapsed += 1
+            if self.heartbeat_elapsed >= self.cfg.heartbeat_every:
+                self.heartbeat_elapsed = 0
+                self._broadcast_append()
+        else:
+            self.election_elapsed += 1
+            if self.election_elapsed >= self.deadline:
+                self._start_election()
+
+    def _broadcast_append(self):
+        for p in range(self.cfg.k):
+            if p == self.id:
+                continue
+            if self.next_index[p] <= self.snap_index:
+                self.transport.send(rpc.InstallSnapshotReq(
+                    rpc.IS_REQ, self.id, p, term=self.term,
+                    snap_index=self.snap_index, snap_term=self.snap_term,
+                    snap_digest=self.snap_digest))
+            else:
+                prev = self.next_index[p] - 1
+                n = min(self.cfg.max_entries_per_msg, self.last_index - prev)
+                lo = prev - self.snap_index
+                entries = tuple(self.log[lo:lo + n])
+                self.transport.send(rpc.AppendEntriesReq(
+                    rpc.AE_REQ, self.id, p, term=self.term,
+                    prev_index=prev, prev_term=self.term_at(prev),
+                    entries=entries, leader_commit=self.commit))
+
+    # ---------------------------------------------------------------- phase C
+
+    def phase_c(self):
+        if self.role != LEADER:
+            return
+        for _ in range(self.cfg.cmds_per_tick):
+            payload = rng.client_payload(
+                self.cfg.seed, self.g, self.term, self.last_index + 1)
+            if not self._append(self.term, payload):
+                break
+
+    # ---------------------------------------------------------------- phase A
+
+    def phase_a(self):
+        if self.role == LEADER:
+            matches = sorted(
+                (self.match_index[p] for p in range(self.cfg.k) if p != self.id),
+                reverse=True)
+            matches.insert(0, self.last_index)  # self always "matches" itself
+            n = matches[self.cfg.majority - 1]
+            # §5.4.2: only entries of the current term commit by counting.
+            if n > self.commit and self.term_at(n) == self.term:
+                self.commit = n
+        while self.applied < self.commit:
+            self.applied += 1
+            t, p = self.log[self.applied - self.snap_index - 1]
+            self.digest = rng.digest_update(self.digest, self.applied, p)
+            if self.on_apply is not None:
+                self.on_apply(self.id, self.applied, t, p)
+        if self.commit - self.snap_index >= self.cfg.compact_every:
+            self.snap_term = self.term_at(self.commit)
+            self.log = self.log[self.commit - self.snap_index:]
+            self.snap_index = self.commit
+            self.snap_digest = self.digest

@@ -1,0 +1,75 @@
+"""Raft RPC message types and the canonical per-tick processing order.
+
+At most one message of each (type, src, dst) exists per tick by
+construction (DESIGN.md §2), so the canonical inbox order — type first,
+then sender id — fully determinizes phase D.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+# Canonical type order for phase D. The TPU path unrolls its handler loop in
+# exactly this order.
+RV_REQ, RV_RESP, AE_REQ, AE_RESP, IS_REQ, IS_RESP = range(6)
+
+
+@dataclasses.dataclass(frozen=True)
+class Msg:
+    type: int
+    src: int
+    dst: int
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestVoteReq(Msg):
+    term: int = 0
+    last_log_index: int = 0
+    last_log_term: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestVoteResp(Msg):
+    term: int = 0
+    granted: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class AppendEntriesReq(Msg):
+    term: int = 0
+    prev_index: int = 0
+    prev_term: int = 0
+    entries: Tuple[Tuple[int, int], ...] = ()   # ((term, payload), ...)
+    leader_commit: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class AppendEntriesResp(Msg):
+    term: int = 0
+    success: bool = False
+    # On success: highest index known replicated (prev + len(entries)).
+    # On failure: conflict fast-backup hint for the leader's next_index.
+    match: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class InstallSnapshotReq(Msg):
+    term: int = 0
+    snap_index: int = 0
+    snap_term: int = 0
+    snap_digest: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class InstallSnapshotResp(Msg):
+    term: int = 0
+    match: int = 0
+
+
+def inbox_sort_key(m: Msg):
+    return (m.type, m.src)
+
+
+def sort_inbox(msgs: List[Msg]) -> List[Msg]:
+    return sorted(msgs, key=inbox_sort_key)

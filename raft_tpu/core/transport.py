@@ -1,0 +1,54 @@
+"""In-memory Transport: the message bus between a group's replicas.
+
+Messages sent during tick ``t`` are delivered at tick ``t+1``, filtered at
+delivery time by the fault model (DESIGN.md §4): dead destinations lose
+their mail, partitioned or dropped links deliver nothing. In-flight mail
+survives a *sender* crash — it already left the node.
+
+This is the seam the TPU backend replaces with a dense device-resident
+mailbox (DESIGN.md §5).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core import rpc
+from raft_tpu.utils import rng
+
+
+class Transport:
+    def __init__(self, cfg: RaftConfig, group: int):
+        self.cfg = cfg
+        self.g = group
+        self._in_flight: List[rpc.Msg] = []   # sent last tick, pending delivery
+        self._outbox: List[rpc.Msg] = []      # sent this tick
+        # Test hook: extra delivery predicate (tick, src, dst) -> bool.
+        # Production faults use the hash-based model below; scenario tests
+        # (staged partitions, targeted drops) use this.
+        self.link_filter = None
+
+    def send(self, msg: rpc.Msg):
+        self._outbox.append(msg)
+
+    def deliver(self, tick: int, alive_now: List[bool]) -> List[List[rpc.Msg]]:
+        """Return per-destination inboxes for this tick and rotate buffers."""
+        cfg = self.cfg
+        inboxes: List[List[rpc.Msg]] = [[] for _ in range(cfg.k)]
+        for m in self._in_flight:
+            if not alive_now[m.dst]:
+                continue
+            if self.link_filter is not None and not self.link_filter(
+                    tick, m.src, m.dst):
+                continue
+            if rng.link_partitioned(cfg.seed, self.g, tick, m.src, m.dst,
+                                    cfg.partition_u32, cfg.partition_epoch):
+                continue
+            if rng.link_dropped(cfg.seed, self.g, tick, m.src, m.dst,
+                                cfg.drop_u32):
+                continue
+            inboxes[m.dst].append(m)
+        self._in_flight = self._outbox
+        self._outbox = []
+        return inboxes

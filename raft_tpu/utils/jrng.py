@@ -1,0 +1,81 @@
+"""JAX uint32 implementation of utils.rng — bit-identical by construction.
+
+All functions accept and return uint32 (or bool) arrays and broadcast like
+ordinary jnp ops, so they can be evaluated for whole [G], [G, K] or
+[G, K, K] coordinate grids at once on device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.utils import rng as _r
+
+_GOLD = jnp.uint32(_r.GOLD)
+_SEED0 = jnp.uint32(0x243F6A88)
+_C1 = jnp.uint32(0x7FEB352D)
+_C2 = jnp.uint32(0x846CA68B)
+
+
+def _u32(x):
+    return jnp.asarray(x).astype(jnp.uint32)
+
+
+def mix32(x):
+    x = _u32(x)
+    x = x ^ (x >> 16)
+    x = x * _C1
+    x = x ^ (x >> 15)
+    x = x * _C2
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash_u32(*vals):
+    h = _SEED0
+    for v in vals:
+        h = mix32(h * _GOLD + _u32(v))
+    return h
+
+
+def election_deadline(seed, g, node, draws, election_min, election_range):
+    r = hash_u32(seed, _r.TAG_TIMEOUT, g, node, draws) % jnp.uint32(election_range)
+    return (jnp.uint32(election_min) + r).astype(jnp.int32)
+
+
+def _full_shape(*coords):
+    return jnp.broadcast_shapes(*(jnp.shape(c) for c in coords))
+
+
+def link_dropped(seed, g, tick, src, dst, drop_u32: int):
+    # drop_u32 is a compile-time config constant <= 0xFFFFFFFF
+    # (config._prob_to_u32); the fast path must keep the full broadcast
+    # shape so faults-off and faults-on programs have identical signatures.
+    if drop_u32 == 0:
+        return jnp.zeros(_full_shape(g, tick, src, dst), jnp.bool_)
+    return hash_u32(seed, _r.TAG_DROP, g, tick, src, dst) < jnp.uint32(drop_u32)
+
+
+def node_alive(seed, g, node, tick, crash_u32: int, crash_epoch: int):
+    if crash_u32 == 0:
+        return jnp.ones(_full_shape(g, node, tick), jnp.bool_)
+    epoch = _u32(tick) // jnp.uint32(crash_epoch)
+    return hash_u32(seed, _r.TAG_CRASH, g, node, epoch) >= jnp.uint32(crash_u32)
+
+
+def link_partitioned(seed, g, tick, src, dst, partition_u32: int, partition_epoch: int):
+    if partition_u32 == 0:
+        return jnp.zeros(_full_shape(g, tick, src, dst), jnp.bool_)
+    epoch = _u32(tick) // jnp.uint32(partition_epoch)
+    active = hash_u32(seed, _r.TAG_PART, g, epoch) < jnp.uint32(partition_u32)
+    side_src = hash_u32(seed, _r.TAG_PART_SIDE, g, epoch, src) & jnp.uint32(1)
+    side_dst = hash_u32(seed, _r.TAG_PART_SIDE, g, epoch, dst) & jnp.uint32(1)
+    return active & (side_src != side_dst)
+
+
+def client_payload(seed, g, term, index):
+    return (hash_u32(seed, _r.TAG_CMD, g, term, index) & jnp.uint32(0x7FFFFFFF)).astype(jnp.int32)
+
+
+def digest_update(digest, index, payload):
+    return mix32(_u32(digest) * _GOLD + mix32(_u32(index) * _GOLD + _u32(payload)))

@@ -1,0 +1,84 @@
+"""Counter-based deterministic randomness shared by both backends.
+
+Everything stochastic in the simulator — election timeouts, message drops,
+crash/partition schedules, client payloads — is a pure function of
+``(seed, tag, coordinates...)`` through a 32-bit hash. There is no stateful
+RNG anywhere: the CPU reference path calls the Python implementation with
+plain ints, the TPU path calls the JAX implementation on uint32 lanes, and
+the two are bit-identical by construction (``tests/test_rng.py``).
+
+The mixer is the public-domain "lowbias32" finalizer (a Murmur3-style
+avalanche); the fold is a multiply-accumulate by the 32-bit golden ratio.
+"""
+
+from __future__ import annotations
+
+_U32 = 0xFFFFFFFF
+GOLD = 0x9E3779B9
+_SEED0 = 0x243F6A88  # pi fraction, arbitrary non-zero start
+
+# Domain-separation tags.
+TAG_TIMEOUT = 1   # election deadline draws
+TAG_DROP = 2      # per-link per-tick message loss
+TAG_CRASH = 3     # per-node per-epoch crash schedule
+TAG_PART = 4      # per-group per-epoch partition active?
+TAG_PART_SIDE = 5  # per-node partition side assignment
+TAG_CMD = 6       # client command payloads
+
+
+def mix32(x: int) -> int:
+    """32-bit avalanche (lowbias32). Pure-Python reference implementation."""
+    x &= _U32
+    x ^= x >> 16
+    x = (x * 0x7FEB352D) & _U32
+    x ^= x >> 15
+    x = (x * 0x846CA68B) & _U32
+    x ^= x >> 16
+    return x
+
+
+def hash_u32(*vals: int) -> int:
+    """Fold arbitrarily many int coordinates into one uint32."""
+    h = _SEED0
+    for v in vals:
+        h = mix32((h * GOLD + (v & _U32)) & _U32)
+    return h
+
+
+def election_deadline(seed: int, g: int, node: int, draws: int,
+                      election_min: int, election_range: int) -> int:
+    """The `draws`-th randomized election deadline for (group, node)."""
+    return election_min + hash_u32(seed, TAG_TIMEOUT, g, node, draws) % election_range
+
+
+def link_dropped(seed: int, g: int, tick: int, src: int, dst: int,
+                 drop_u32: int) -> bool:
+    return hash_u32(seed, TAG_DROP, g, tick, src, dst) < drop_u32
+
+
+def node_alive(seed: int, g: int, node: int, tick: int,
+               crash_u32: int, crash_epoch: int) -> bool:
+    return hash_u32(seed, TAG_CRASH, g, node, tick // crash_epoch) >= crash_u32
+
+
+def link_partitioned(seed: int, g: int, tick: int, src: int, dst: int,
+                     partition_u32: int, partition_epoch: int) -> bool:
+    epoch = tick // partition_epoch
+    if hash_u32(seed, TAG_PART, g, epoch) >= partition_u32:
+        return False
+    side_src = hash_u32(seed, TAG_PART_SIDE, g, epoch, src) & 1
+    side_dst = hash_u32(seed, TAG_PART_SIDE, g, epoch, dst) & 1
+    return side_src != side_dst
+
+
+def client_payload(seed: int, g: int, term: int, index: int) -> int:
+    """Deterministic opaque payload for the entry at (group, term, index).
+
+    Kept in int32 range so numpy/JAX int32 lanes hold it exactly.
+    """
+    return hash_u32(seed, TAG_CMD, g, term, index) & 0x7FFFFFFF
+
+
+def digest_update(digest: int, index: int, payload: int) -> int:
+    """State-machine hash chain: apply entry `index` with `payload`."""
+    return mix32((digest * GOLD + mix32((index * GOLD + payload) & _U32)) & _U32)

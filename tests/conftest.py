@@ -1,0 +1,16 @@
+"""Force tests onto a virtual 8-device CPU platform.
+
+Must run before `import jax` anywhere in the test process: the driver's
+multi-chip validation uses the same mechanism
+(xla_force_host_platform_device_count), and tests must not depend on real
+TPU hardware being attached.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,361 @@
+"""CPU reference path scenario tests (SURVEY.md §4's canonical set).
+
+These do not import jax — they are pure-Python and fast. They are the
+ground truth the TPU path is differentially tested against.
+"""
+
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.cluster import Cluster, SafetyViolation
+from raft_tpu.core.node import FOLLOWER, CANDIDATE, LEADER, Node
+
+
+def make(seed=0, k=3, ticks=0, **kw):
+    cfg = RaftConfig(seed=seed, k=k, **kw)
+    c = Cluster(cfg)
+    if ticks:
+        c.run(ticks)
+    return c
+
+
+def all_digests_consistent(c: Cluster):
+    """Nodes with equal applied index must have equal digests."""
+    by_applied = {}
+    for n in c.nodes:
+        if n.applied in by_applied:
+            assert by_applied[n.applied] == n.digest, (
+                f"digest divergence at applied={n.applied}")
+        by_applied[n.applied] = n.digest
+
+
+# ------------------------------------------------------------------ election
+
+def test_single_group_elects_leader():
+    for seed in range(5):
+        c = make(seed=seed, k=3, ticks=60)
+        assert c.leader() is not None, f"no leader by tick 60 (seed {seed})"
+
+
+def test_five_node_group_elects_leader():
+    for seed in range(5):
+        c = make(seed=seed, k=5, ticks=60)
+        assert c.leader() is not None
+
+
+def test_k1_group_is_immediately_leader_and_commits():
+    c = make(k=1, ticks=40)
+    assert c.leader() == 0
+    n = c.nodes[0]
+    assert n.commit > 0
+    assert n.applied == n.commit
+
+
+def test_exactly_one_leader_per_term_over_long_run():
+    # Safety checker inside Cluster raises on any election-safety violation.
+    for seed in range(3):
+        make(seed=seed, k=5, ticks=400)
+
+
+# --------------------------------------------------------------- replication
+
+def test_config1_replicates_1000_entries():
+    """Config 1 of BASELINE.json: 3-node group, 1K committed entries."""
+    c = make(seed=1, k=3)
+    target = 1000
+    for _ in range(5000):
+        c.tick()
+        if min(n.commit for n in c.nodes) >= target:
+            break
+    assert min(n.commit for n in c.nodes) >= target
+    all_digests_consistent(c)
+    # Snapshot compaction kept every window bounded.
+    for n in c.nodes:
+        assert n.last_index - n.snap_index <= c.cfg.log_cap
+
+
+def test_followers_apply_same_prefix():
+    c = make(seed=2, k=5, ticks=300)
+    all_digests_consistent(c)
+    assert c.total_applies > 0
+
+
+# -------------------------------------------------------------- leader crash
+
+def test_reelection_after_leader_crash():
+    c = make(seed=3, k=3)
+    c.run(80)
+    first = c.leader()
+    assert first is not None
+    first_term = c.nodes[first].term
+    crash_at = c.tick_count
+    c.alive_fn = lambda t: [i != first or t < crash_at for i in range(3)]
+    c.run(80)
+    new = c.leader()
+    assert new is not None and new != first
+    assert c.nodes[new].term > first_term
+    # Replication continues under the new leader.
+    commit_before = max(n.commit for n in c.nodes if n.id != first)
+    c.run(40)
+    assert max(n.commit for n in c.nodes if n.id != first) > commit_before
+
+
+def test_crashed_leader_rejoins_and_catches_up():
+    c = make(seed=4, k=3)
+    c.run(80)
+    first = c.leader()
+    assert first is not None
+    crash_at = c.tick_count
+    down_until = crash_at + 120
+    c.alive_fn = lambda t: [i != first or not (crash_at <= t < down_until)
+                            for i in range(3)]
+    c.run(120)          # crash window: others elect + commit a lot
+    c.run(200)          # rejoin: must catch up (via AE or InstallSnapshot)
+    rejoined = c.nodes[first]
+    lead = c.leader()
+    assert lead is not None and lead != first
+    assert rejoined.role == FOLLOWER
+    assert rejoined.commit >= c.nodes[lead].commit - 2 * c.cfg.heartbeat_every * c.cfg.cmds_per_tick - c.cfg.max_entries_per_msg
+    all_digests_consistent(c)
+
+
+def test_snapshot_install_repairs_long_lag():
+    # Long outage so the leader compacts far past the dead node's log.
+    c = make(seed=5, k=3, compact_every=8, log_cap=16)
+    c.run(80)
+    first = c.leader()
+    assert first is not None
+    victim = (first + 1) % 3
+    crash_at = c.tick_count
+    down_until = crash_at + 400
+    c.alive_fn = lambda t: [i != victim or not (crash_at <= t < down_until)
+                            for i in range(3)]
+    c.run(400)
+    lead = c.leader()
+    assert lead is not None
+    gap = c.nodes[lead].snap_index   # compaction point at rejoin time
+    assert gap > c.nodes[victim].last_index, (
+        "test premise: leader compacted beyond the victim's log")
+    c.run(100)
+    # Committing past `gap` is only possible after an InstallSnapshot —
+    # the entries below it no longer exist anywhere on the wire.
+    assert c.nodes[victim].commit > gap, "victim must have installed a snapshot"
+    all_digests_consistent(c)
+
+
+# ----------------------------------------------------------------- partition
+
+def test_minority_partition_cannot_commit():
+    c = make(seed=6, k=5)
+    c.run(80)
+    lead = c.leader()
+    assert lead is not None
+    # Isolate the leader with one follower (minority side).
+    buddy = (lead + 1) % 5
+    side = {lead, buddy}
+    part_at = c.tick_count
+    c.transport.link_filter = lambda t, s, d: (
+        t < part_at or ((s in side) == (d in side)))
+    minority_commit = c.nodes[lead].commit
+    c.run(150)
+    # Old leader may still think it leads but must not advance its commit.
+    assert c.nodes[lead].commit == minority_commit, (
+        "leader in minority partition advanced commit — split brain")
+    # Majority side elected a fresh leader and kept committing.
+    maj_leader = c.leader()
+    assert maj_leader is not None and maj_leader not in side
+    assert c.nodes[maj_leader].commit > minority_commit
+    # Heal: old leader steps down, everyone converges.
+    c.transport.link_filter = None
+    c.run(150)
+    assert c.nodes[lead].role != LEADER
+    all_digests_consistent(c)
+
+
+def test_partition_heal_discards_uncommitted_minority_entries():
+    c = make(seed=7, k=5)
+    c.run(80)
+    lead = c.leader()
+    assert lead is not None
+    buddy = (lead + 1) % 5
+    side = {lead, buddy}
+    part_at = c.tick_count
+    c.transport.link_filter = lambda t, s, d: (
+        t < part_at or ((s in side) == (d in side)))
+    c.run(120)
+    stale_last = c.nodes[lead].last_index   # uncommitted minority appends
+    assert stale_last > c.nodes[lead].commit
+    c.transport.link_filter = None
+    c.run(200)
+    # The minority suffix was overwritten by the majority leader's log.
+    all_digests_consistent(c)
+    new_lead = c.leader()
+    assert new_lead is not None
+    lo = min(n.commit for n in c.nodes)
+    assert lo > 0
+
+
+# ------------------------------------------------------- figure-8 / §5.4.2
+
+def test_commit_restriction_prior_term_not_counted():
+    """Raft §5.4.2 (figure 8): a leader never commits a prior-term entry by
+    counting replicas; it may only commit it below a current-term entry."""
+    cfg = RaftConfig(k=5, cmds_per_tick=0)
+    c = Cluster(cfg)
+    n = c.nodes[0]
+    # Hand-craft: node 0 is leader of term 4; log has entries of terms [2, 2, 4].
+    n.term = 4
+    n.role = LEADER
+    n.leader_id = 0
+    n.log = [(2, 11), (2, 12), (4, 13)]
+    # A majority replicated index 2 (a term-2 entry) but not index 3.
+    n.match_index = [0, 2, 2, 0, 0]
+    n.phase_a()
+    assert n.commit == 0, "must NOT commit prior-term entry by counting"
+    # Once a CURRENT-term entry reaches a majority, everything below commits.
+    n.match_index = [0, 3, 3, 0, 0]
+    n.phase_a()
+    assert n.commit == 3
+
+
+def test_vote_up_to_date_check():
+    cfg = RaftConfig(k=3)
+    c = Cluster(cfg)
+    n = c.nodes[0]
+    n.term = 5
+    n.log = [(1, 1), (5, 2)]   # last term 5, last index 2
+    from raft_tpu.core import rpc
+    # Candidate with shorter log of same last term: reject.
+    n._on_rv_req(rpc.RequestVoteReq(rpc.RV_REQ, 1, 0, term=5,
+                                    last_log_index=1, last_log_term=5))
+    assert n.voted_for == -1
+    # Candidate with longer log, lower last term: reject.
+    n._on_rv_req(rpc.RequestVoteReq(rpc.RV_REQ, 1, 0, term=5,
+                                    last_log_index=9, last_log_term=4))
+    assert n.voted_for == -1
+    # Up-to-date candidate: grant.
+    n._on_rv_req(rpc.RequestVoteReq(rpc.RV_REQ, 2, 0, term=5,
+                                    last_log_index=2, last_log_term=5))
+    assert n.voted_for == 2
+    # Already voted this term for 2: reject 1 even if up-to-date.
+    n._on_rv_req(rpc.RequestVoteReq(rpc.RV_REQ, 1, 0, term=5,
+                                    last_log_index=3, last_log_term=5))
+    assert n.voted_for == 2
+
+
+def test_conflict_fast_backup_hint():
+    cfg = RaftConfig(k=3, cmds_per_tick=0)
+    c = Cluster(cfg)
+    n = c.nodes[0]
+    from raft_tpu.core import rpc
+    n.term = 3
+    n.log = [(1, 1), (2, 2), (2, 3), (2, 4)]   # terms 1,2,2,2 at idx 1..4
+    n._on_ae_req(rpc.AppendEntriesReq(
+        rpc.AE_REQ, 1, 0, term=3, prev_index=4, prev_term=3,
+        entries=(), leader_commit=0))
+    # Conflicting term at prev=4 is 2; first index of term 2 is 2.
+    resp = [m for m in c.transport._outbox if m.type == rpc.AE_RESP][-1]
+    assert resp.success is False and resp.match == 2
+
+
+def test_window_flow_control_never_overflows():
+    c = make(seed=8, k=3, log_cap=12, compact_every=4, cmds_per_tick=3,
+             ticks=300)
+    for n in c.nodes:
+        assert n.last_index - n.snap_index <= 12
+    all_digests_consistent(c)
+    assert min(n.commit for n in c.nodes) > 0
+
+
+# -------------------------------------------- takeover with a full window
+
+def test_takeover_with_full_window_stays_live():
+    """Regression: a new leader whose window is FULL of prior-term entries
+    must still make progress. With the naive append-a-no-op takeover this
+    wedges forever (no room for a current-term entry, §5.4.2 blocks commit,
+    no commit → no compaction → no room). Term re-proposal (DESIGN.md §2a)
+    rewrites the suffix in place instead."""
+    c = make(seed=9, k=5, log_cap=12, compact_every=4)
+    c.run(80)
+    lead = c.leader()
+    assert lead is not None
+    buddy = [i for i in range(5) if i != lead][0]
+    # Only the buddy's acks reach the leader: entries replicate to the buddy
+    # (its next_index advances) but 2 < majority(3), so nothing commits and
+    # the leader appends until its window is full — mirrored by the buddy.
+    cut_at = c.tick_count
+    c.transport.link_filter = lambda t, s, d: (
+        t < cut_at or d != lead or s in (lead, buddy))
+    c.run(200)
+    stuck = c.nodes[lead]
+    assert stuck.last_index - stuck.snap_index == c.cfg.log_cap, (
+        "test premise: leader filled its window with uncommitted entries")
+    assert c.nodes[buddy].last_index == stuck.last_index, (
+        "test premise: buddy mirrors the full window")
+    assert c.nodes[buddy].commit == stuck.commit
+    # Kill the old leader (and one short-log follower, so that the remaining
+    # quorum {buddy, f2, f3} can only elect the buddy: the short-log
+    # followers can never gather 3 votes past the buddy's up-to-date check).
+    # The buddy must win and commit through its FULL inherited window.
+    others = [i for i in range(5) if i not in (lead, buddy)]
+    dead = {lead, others[0]}
+    dead_at = c.tick_count
+    c.transport.link_filter = None
+    c.alive_fn = lambda t: [i not in dead or t < dead_at for i in range(5)]
+    c.run(200)
+    new = c.leader()
+    assert new == buddy, "staging: only the buddy should be electable"
+    assert c.nodes[new].commit > stuck.last_index, (
+        "new leader wedged: could not commit past the inherited window")
+    all_digests_consistent(c)
+
+
+def test_takeover_reproposal_preserves_payloads():
+    """Re-proposal changes terms, never (index, payload): digests of the
+    survivors must match the payloads the old leader appended."""
+    c = make(seed=10, k=5)
+    c.run(80)
+    lead = c.leader()
+    assert lead is not None
+    buddy = [i for i in range(5) if i != lead][0]
+    cut_at = c.tick_count
+    c.transport.link_filter = lambda t, s, d: (
+        t < cut_at or d != lead or s in (lead, buddy))
+    c.run(60)
+    # Snapshot the uncommitted suffix payloads the buddy replicated.
+    f = c.nodes[buddy]
+    suffix = {i: f.payload_at(i) for i in range(f.commit + 1, f.last_index + 1)}
+    assert suffix, "test premise: some uncommitted replicated entries exist"
+    # Same staging as above: only the buddy is electable in the new quorum.
+    others = [i for i in range(5) if i not in (lead, buddy)]
+    dead = {lead, others[0]}
+    dead_at = c.tick_count
+    c.transport.link_filter = None
+    c.alive_fn = lambda t: [i not in dead or t < dead_at for i in range(5)]
+    c.run(200)
+    new = c.leader()
+    assert new == buddy, "staging: only the buddy should be electable"
+    n = c.nodes[new]
+    for idx, payload in suffix.items():
+        assert idx <= n.commit, f"inherited entry {idx} never committed"
+        assert c._committed[idx] == payload, (
+            "re-proposal changed a payload — safety violation")
+    all_digests_consistent(c)
+
+
+# ------------------------------------------------------------ fault schedule
+
+def test_hash_fault_schedule_run_is_safe():
+    """Config-4 style run on CPU: random crashes via the hash schedule."""
+    cfg = RaftConfig(seed=11, k=5, crash_prob=0.2, crash_epoch=32)
+    c = Cluster(cfg)
+    c.run(600)   # SafetyViolation would raise from the checker
+    all_digests_consistent(c)
+
+
+def test_hash_partition_and_drop_run_is_safe():
+    cfg = RaftConfig(seed=12, k=5, partition_prob=0.3, partition_epoch=40,
+                     drop_prob=0.05)
+    c = Cluster(cfg)
+    c.run(600)
+    all_digests_consistent(c)

@@ -1,0 +1,102 @@
+"""Bit-parity of the Python and JAX counter-based RNGs (DESIGN.md §4).
+
+The JAX side is evaluated on whole coordinate grids in a few calls (the way
+the simulator uses it) — per-scalar eager dispatch is orders of magnitude
+too slow for a test suite.
+"""
+
+import numpy as np
+
+from raft_tpu.utils import rng as pr
+from raft_tpu.utils import jrng as jr
+
+
+def test_mix32_known_values():
+    # Self-consistency anchors: if the mixer changes, every trace changes.
+    assert pr.mix32(0) == 0
+    vals = [pr.mix32(x) for x in (1, 2, 0xDEADBEEF, 0xFFFFFFFF)]
+    assert len(set(vals)) == 4
+    assert all(0 <= v <= 0xFFFFFFFF for v in vals)
+
+
+def test_mix32_parity():
+    xs = np.array([0, 1, 2, 3, 12345, 0xDEADBEEF, 0xFFFFFFFF], dtype=np.uint32)
+    got = np.asarray(jr.mix32(xs))
+    want = np.array([pr.mix32(int(x)) for x in xs], dtype=np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_hash_u32_parity_grid():
+    G, K = 17, 5
+    g = np.arange(G, dtype=np.uint32)[:, None]
+    n = np.arange(K, dtype=np.uint32)[None, :]
+    got = np.asarray(jr.hash_u32(42, 7, g, n))
+    want = np.array(
+        [[pr.hash_u32(42, 7, gi, ni) for ni in range(K)] for gi in range(G)],
+        dtype=np.uint32,
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_election_deadline_parity_and_range():
+    seed, emin, erange = 3, 10, 10
+    G, K, D = 4, 5, 6
+    g = np.arange(G, dtype=np.uint32)[:, None, None]
+    n = np.arange(K, dtype=np.uint32)[None, :, None]
+    d = np.arange(D, dtype=np.uint32)[None, None, :]
+    got = np.asarray(jr.election_deadline(seed, g, n, d, emin, erange))
+    want = np.array(
+        [[[pr.election_deadline(seed, gi, ni, di, emin, erange)
+           for di in range(D)] for ni in range(K)] for gi in range(G)],
+        dtype=np.int32,
+    )
+    np.testing.assert_array_equal(got, want)
+    assert got.min() >= emin and got.max() < emin + erange
+
+
+def test_fault_mask_parity():
+    seed = 9
+    drop_u32 = int(0.3 * 2**32)
+    crash_u32 = int(0.2 * 2**32)
+    part_u32 = int(0.5 * 2**32)
+    G, K, T = 6, 3, 10
+    t = np.arange(T, dtype=np.uint32)[:, None, None, None]
+    g = np.arange(G, dtype=np.uint32)[None, :, None, None]
+    a = np.arange(K, dtype=np.uint32)[None, None, :, None]
+    b = np.arange(K, dtype=np.uint32)[None, None, None, :]
+
+    got_alive = np.asarray(jr.node_alive(seed, g, a, t, crash_u32, 4))
+    got_drop = np.asarray(jr.link_dropped(seed, g, t, a, b, drop_u32))
+    got_part = np.asarray(jr.link_partitioned(seed, g, t, a, b, part_u32, 4))
+    for ti in range(T):
+        for gi in range(G):
+            for ai in range(K):
+                assert bool(got_alive[ti, gi, ai, 0]) == pr.node_alive(
+                    seed, gi, ai, ti, crash_u32, 4)
+                for bi in range(K):
+                    assert bool(got_drop[ti, gi, ai, bi]) == pr.link_dropped(
+                        seed, gi, ti, ai, bi, drop_u32)
+                    assert bool(got_part[ti, gi, ai, bi]) == pr.link_partitioned(
+                        seed, gi, ti, ai, bi, part_u32, 4)
+    # Disabled faults take the fast path and must be all-clear.
+    assert np.asarray(jr.node_alive(seed, g, a, t, 0, 4)).all()
+    assert not np.asarray(jr.link_dropped(seed, g, t, a, b, 0)).any()
+    assert not np.asarray(jr.link_partitioned(seed, g, t, a, b, 0, 4)).any()
+
+
+def test_payload_and_digest_parity():
+    seed = 1
+    idx = np.arange(1, 20, dtype=np.uint32)
+    got_p = np.asarray(jr.client_payload(seed, 3, 2, idx))
+    want_p = np.array([pr.client_payload(seed, 3, 2, int(i)) for i in idx],
+                      dtype=np.int32)
+    np.testing.assert_array_equal(got_p, want_p)
+    assert (got_p >= 0).all()
+
+    d_py = 0
+    d_np = np.uint32(0)
+    for i in range(1, 20):
+        p = int(want_p[i - 1])
+        d_py = pr.digest_update(d_py, i, p)
+        d_np = jr.digest_update(d_np, i, p)
+    assert d_py == int(np.asarray(d_np))
