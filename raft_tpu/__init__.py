@@ -4,10 +4,12 @@ Two backends behind one deterministic tick contract (see DESIGN.md):
 
 - ``raft_tpu.core``: the CPU reference path — classical ``Node`` /
   ``Transport`` / ``Cluster`` objects, one group at a time. Ground truth.
-- ``raft_tpu.sim``: the TPU batched path — a pure ``step`` function over a
+- ``raft_tpu.sim``: the TPU batched path — a pure ``tick`` function over a
   struct-of-arrays state for ``[n_groups, k]`` replicas, vmapped/jitted/
-  scanned, sharded over a device mesh (``raft_tpu.parallel``). See the
-  module's own docs for availability of each piece.
+  scanned (``sim.step``, ``sim.run``), sharded over a device mesh
+  (``raft_tpu.parallel``), with quorum reductions in ``raft_tpu.ops``.
+  ``tests/test_differential.py`` holds the two backends bit-identical
+  per node per tick under every fault class.
 
 Reference parity note: the upstream reference (qzwsq/raft, expected at
 /root/reference) was empty at survey and build time — see SURVEY.md. The

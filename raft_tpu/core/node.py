@@ -108,15 +108,20 @@ class Node:
         self.match_index = [0] * self.cfg.k
         # Fire the initial heartbeat in phase T of this same tick.
         self.heartbeat_elapsed = self.cfg.heartbeat_every
-        # Paxos-style takeover (DESIGN.md §2a): re-propose the uncommitted
-        # suffix under the new term, in place. Unlike the common "append a
-        # no-op" idiom this cannot grow the log, so it stays live under the
-        # bounded window: a full window of prior-term entries would otherwise
-        # wedge the group forever (§5.4.2 forbids counting prior-term
-        # replicas, and with no room for a current-term entry, commit — and
-        # hence compaction — could never advance).
-        for i in range(self.commit + 1, self.last_index + 1):
-            pos = i - self.snap_index - 1
+        # Paxos-style takeover (DESIGN.md §2a): re-propose the TOP entry —
+        # and only the top — under the new term, in place. Like the common
+        # "append a no-op" idiom this creates a current-term entry whose
+        # replication commits the whole inherited suffix (§5.4.2), but it
+        # cannot grow the log, so takeover stays live when the bounded
+        # window is full of uncommitted prior-term entries. Restricting the
+        # rewrite to last_index is what keeps elections safe: current-term
+        # entries then exist only at-or-above every committed index, so a
+        # log whose last term is T' provably extends the T'-leader's log
+        # and hence holds every committed entry (the round-1 variant that
+        # re-termed the whole suffix created new-term entries BELOW the
+        # committed frontier and broke Leader Completeness — see §2a).
+        if self.last_index > self.commit:
+            pos = self.last_index - self.snap_index - 1
             self.log[pos] = (self.term, self.log[pos][1])
 
     def _start_election(self):

@@ -22,8 +22,7 @@ class Transport:
     def __init__(self, cfg: RaftConfig, group: int):
         self.cfg = cfg
         self.g = group
-        self._in_flight: List[rpc.Msg] = []   # sent last tick, pending delivery
-        self._outbox: List[rpc.Msg] = []      # sent this tick
+        self._outbox: List[rpc.Msg] = []      # sent this tick, in flight
         # Test hook: extra delivery predicate (tick, src, dst) -> bool.
         # Production faults use the hash-based model below; scenario tests
         # (staged partitions, targeted drops) use this.
@@ -33,10 +32,15 @@ class Transport:
         self._outbox.append(msg)
 
     def deliver(self, tick: int, alive_now: List[bool]) -> List[List[rpc.Msg]]:
-        """Return per-destination inboxes for this tick and rotate buffers."""
+        """Return per-destination inboxes for this tick and rotate buffers.
+
+        Called at the start of tick ``tick``, before any phase runs, so
+        ``_outbox`` holds exactly the messages sent during tick
+        ``tick - 1`` — the t+1 delivery the tick contract specifies.
+        """
         cfg = self.cfg
         inboxes: List[List[rpc.Msg]] = [[] for _ in range(cfg.k)]
-        for m in self._in_flight:
+        for m in self._outbox:
             if not alive_now[m.dst]:
                 continue
             if self.link_filter is not None and not self.link_filter(
@@ -49,6 +53,5 @@ class Transport:
                                 cfg.drop_u32):
                 continue
             inboxes[m.dst].append(m)
-        self._in_flight = self._outbox
         self._outbox = []
         return inboxes

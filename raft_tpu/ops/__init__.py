@@ -1,0 +1,1 @@
+"""Batched per-group reduction ops for the TPU path (DESIGN.md §5)."""

@@ -1,0 +1,51 @@
+"""Quorum reductions over the K (replica) axis, batched over groups.
+
+The north star maps vote counting to a masked popcount and commit advance
+to a k-th order statistic of ``match_index`` ("segment-reduce /
+prefix-scan"). Both are written for ONE node (vectors of length K) and
+lifted over `[G, K]` with `vmap` by the caller — K is a tiny compile-time
+constant (typically 5), so a full sort is a handful of vectorized
+compare-exchanges; the batch axis G is where the parallelism lives.
+
+Semantics are pinned to the CPU oracle, `core/node.py`:
+
+- `vote_count` == ``sum(self.votes)`` in `node.py` `_on_rv_resp`.
+- `commit_candidate` == the `matches[majority - 1]` computation in
+  `node.py` `phase_a`: peer match indices sorted descending, with
+  ``last_index`` prepended as the leader's own (always-largest-ranked)
+  entry — NOT mixed into the sort. `tests/test_quorum.py` property-tests
+  this equivalence on random states.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def vote_count(votes):
+    """Number of granted votes. ``votes``: bool[K] (or any trailing shape)."""
+    return jnp.sum(votes.astype(jnp.int32), axis=-1)
+
+
+def commit_candidate(match_index, last_index, node_id, k: int, majority: int):
+    """The highest index N replicated on a majority, per `node.py` phase_a.
+
+    Args:
+      match_index: int32[K] — the leader's view of peer replication.
+      last_index: int32 scalar — the leader's own last log index.
+      node_id: int32 scalar — the leader's id (its own match slot is
+        excluded from the sort; the leader "matches itself" at
+        ``last_index``, ranked first regardless of value).
+      k, majority: static config constants.
+
+    Returns int32 scalar: the candidate commit index (still subject to the
+    §5.4.2 current-term check, done by the caller).
+    """
+    if majority == 1:
+        return last_index
+    # Exclude the self slot by forcing it below any real match index
+    # (match_index >= 0 always), then take the (majority-1)-th largest of
+    # the K-1 peer values == index majority-2 of the descending sort.
+    peers = jnp.where(jnp.arange(k) == node_id, jnp.int32(-1), match_index)
+    desc = jnp.sort(peers)[::-1]
+    return desc[majority - 2]

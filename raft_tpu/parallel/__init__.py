@@ -1,0 +1,7 @@
+"""Device-mesh sharding of the groups axis (DESIGN.md §5, config 5)."""
+
+from raft_tpu.parallel.mesh import (AXIS, make_mesh, run_sharded,
+                                    shard_state, state_sharding)
+
+__all__ = ["AXIS", "make_mesh", "run_sharded", "shard_state",
+           "state_sharding"]

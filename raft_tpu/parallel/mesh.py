@@ -1,0 +1,95 @@
+"""1-D mesh over the groups axis: shard_map + psum'd metrics.
+
+Groups are embarrassingly parallel (no cross-group messages), so the G
+axis shards over a 1-D `jax.sharding.Mesh` and the ONLY cross-device
+traffic is the psum of metric aggregates at the end of a run — riding
+ICI on a real slice, DCN across hosts (SURVEY.md §5: config 5's
+"sharded over ICI" is data-parallel group sharding, not intra-group RPC).
+
+Correct sharding depends on `State.group_id` traveling with the shard:
+each device simulates its own global group indices' seed streams (see
+sim/state.py). `tests/test_parallel.py` pins bit-identity between an
+8-device sharded run and the unsharded reference.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.sim.run import metrics_init, run
+from raft_tpu.sim.state import State
+
+AXIS = "g"
+
+
+def _pvary(x, axis):
+    """Mark `x` as varying over `axis` (API name moved across jax versions)."""
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, (axis,))
+    return jax.lax.pcast(x, (axis,), to="varying")
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the first `n_devices` of `devices`.
+
+    Falls back to the virtual CPU platform when the default platform has
+    too few devices (the TPU plugin in this image exposes a single chip;
+    the 8-way CPU split is the multi-chip test vehicle)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None and len(devices) < n_devices:
+            devices = jax.devices("cpu")
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (AXIS,))
+
+
+def state_sharding(mesh: Mesh) -> NamedSharding:
+    """Every State leaf shards its leading (G) axis; the rest replicate."""
+    return NamedSharding(mesh, P(AXIS))
+
+
+def shard_state(st: State, mesh: Mesh) -> State:
+    return jax.device_put(st, state_sharding(mesh))
+
+
+class GlobalMetrics(NamedTuple):
+    rounds: jnp.ndarray      # i32 — total committed entries, psum over mesh
+    elections: jnp.ndarray   # i32 — completed leader acquisitions, psum
+    hist: jnp.ndarray        # i32[H] — election-latency histogram, psum
+
+
+def run_sharded(cfg: RaftConfig, st: State, n_ticks: int, mesh: Mesh,
+                t0: int = 0):
+    """Run `n_ticks` with the G axis sharded over `mesh`.
+
+    Returns (state, GlobalMetrics): state stays sharded (leading axis
+    over the mesh); metrics are psum-reduced and replicated.
+    """
+
+    def local(st_local):
+        # The zero-valued initial metrics are constants inside the shard —
+        # unvarying over the mesh axis — while the updated metrics coming
+        # out of the scan body vary per shard; mark them varying up front
+        # or the scan carry types mismatch under shard_map.
+        m0 = jax.tree.map(lambda a: _pvary(a, AXIS),
+                          metrics_init(st_local.alive_prev.shape[0]))
+        s, m = run(cfg, st_local, n_ticks, t0, m0)
+        return s, GlobalMetrics(
+            rounds=jax.lax.psum(jnp.sum(m.committed), AXIS),
+            elections=jax.lax.psum(m.elections, AXIS),
+            hist=jax.lax.psum(m.hist, AXIS),
+        )
+
+    f = jax.shard_map(local, mesh=mesh, in_specs=(P(AXIS),),
+                      out_specs=(P(AXIS), P()))
+    return jax.jit(f)(st)

@@ -1,0 +1,12 @@
+"""The TPU batched backend: pure step over struct-of-arrays Raft state.
+
+`state.py` defines the `[G, K]` SoA pytree (DESIGN.md §5); `step.py` is the
+pure tick function mirroring `core/node.py` branch-for-branch; `run.py`
+wraps it in `lax.scan` under `jit` and accumulates metrics.
+"""
+
+from raft_tpu.sim.state import Mailbox, PerNode, State, init
+from raft_tpu.sim.step import tick
+from raft_tpu.sim.run import run, Metrics
+
+__all__ = ["Mailbox", "PerNode", "State", "init", "tick", "run", "Metrics"]

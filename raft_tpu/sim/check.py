@@ -1,0 +1,58 @@
+"""Batched invariant checks over a `State` — the `Cluster` safety
+checkers (cluster.py:73-96) lifted to `[G, K]` arrays.
+
+Used by tests and `__graft_entry__.dryrun_multichip`; not part of the
+hot path. The differential suite is the strong correctness gate; these
+catch gross violations cheaply at 10^5-group scale where lockstep
+comparison is impractical.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax.numpy as jnp
+
+from raft_tpu.core.node import LEADER
+from raft_tpu.sim.state import State
+
+
+def election_safety(st: State):
+    """bool[G]: no two current leaders share a term (point-in-time form of
+    cluster._check_election_safety; crashed leaders still hold their term)."""
+    nodes = st.nodes
+    k = nodes.term.shape[1]
+    ok = jnp.ones(nodes.term.shape[0], jnp.bool_)
+    for a, b in itertools.combinations(range(k), 2):
+        clash = ((nodes.role[:, a] == LEADER) & (nodes.role[:, b] == LEADER)
+                 & (nodes.term[:, a] == nodes.term[:, b]))
+        ok &= ~clash
+    return ok
+
+
+def digest_agreement(st: State):
+    """bool[G]: nodes that applied the same prefix hold the same state-
+    machine digest (commit-identity, cluster._on_apply's invariant)."""
+    nodes = st.nodes
+    k = nodes.term.shape[1]
+    ok = jnp.ones(nodes.term.shape[0], jnp.bool_)
+    for a, b in itertools.combinations(range(k), 2):
+        clash = ((nodes.applied[:, a] == nodes.applied[:, b])
+                 & (nodes.digest[:, a] != nodes.digest[:, b]))
+        ok &= ~clash
+    return ok
+
+
+def window_bounds(st: State, log_cap: int):
+    """bool[G]: per-node structural sanity — applied == commit (phase A
+    drains), snap <= commit <= last, window within the ring capacity."""
+    n = st.nodes
+    ok = ((n.applied == n.commit)
+          & (n.snap_index <= n.commit) & (n.commit <= n.last_index)
+          & (n.last_index - n.snap_index <= log_cap))
+    return jnp.all(ok, axis=1)
+
+
+def all_invariants(st: State, log_cap: int):
+    return election_safety(st) & digest_agreement(st) & window_bounds(
+        st, log_cap)

@@ -1,0 +1,123 @@
+"""Scanned multi-tick runner + metrics for the batched path (DESIGN.md §6).
+
+`run` wraps `sim.step.tick` in `lax.scan` under `jit`, so a whole
+N-tick simulation is one device program: state stays resident in HBM,
+zero host<->device traffic inside the loop.
+
+Metrics:
+- `committed[G]`: running max over ticks of the per-group max commit
+  index — total entries durably committed by the group ("consensus
+  rounds"; a restart rewinds a node's local commit knowledge, never the
+  group's achievement, hence the running max).
+- election latency: per group, the length of each leaderless streak
+  (ticks with no alive leader), recorded into a bounded histogram
+  `[0..H)` when a leader (re)appears; bucket H-1 absorbs the tail.
+  p50/p99 are computed host-side from the histogram (`latency_quantile`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.node import LEADER
+from raft_tpu.sim.state import I32, State
+from raft_tpu.sim.step import tick
+
+HIST_SIZE = 128
+
+
+class Metrics(NamedTuple):
+    committed: jnp.ndarray   # i32[G] — running max of per-group max commit
+    leaderless: jnp.ndarray  # i32[G] — current leaderless streak, in ticks
+    elections: jnp.ndarray   # i32 — completed leader-acquisition events
+    hist: jnp.ndarray        # i32[H] — election-latency histogram
+
+
+def metrics_init(n_groups: int, hist_size: int = HIST_SIZE) -> Metrics:
+    return Metrics(
+        committed=jnp.zeros(n_groups, I32),
+        leaderless=jnp.zeros(n_groups, I32),
+        elections=jnp.zeros((), I32),
+        hist=jnp.zeros(hist_size, I32),
+    )
+
+
+def metrics_update(m: Metrics, st: State) -> Metrics:
+    """Fold one post-tick state into the metrics."""
+    nodes = st.nodes
+    committed = jnp.maximum(m.committed, jnp.max(nodes.commit, axis=1))
+    has_leader = jnp.any((nodes.role == LEADER) & st.alive_prev, axis=1)
+    done = has_leader & (m.leaderless > 0)
+    hist_size = m.hist.shape[0]
+    bucket = jnp.minimum(m.leaderless, hist_size - 1)
+    return Metrics(
+        committed=committed,
+        leaderless=jnp.where(has_leader, 0, m.leaderless + 1),
+        elections=m.elections + jnp.sum(done.astype(I32)),
+        hist=m.hist.at[bucket].add(done.astype(I32)),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def run(cfg: RaftConfig, st: State, n_ticks: int, t0=0,
+        metrics: Metrics | None = None):
+    """Run `n_ticks` global ticks starting at absolute tick `t0`.
+
+    Returns (state, metrics). Donatable; call again with the returned
+    state and `t0 + n_ticks` to continue the same deterministic universe.
+    """
+    if metrics is None:
+        metrics = metrics_init(st.alive_prev.shape[0])
+
+    def body(carry, t):
+        s, m = carry
+        s = tick(cfg, s, t)
+        return (s, metrics_update(m, s)), None
+
+    (st, metrics), _ = jax.lax.scan(
+        body, (st, metrics), t0 + jnp.arange(n_ticks, dtype=I32))
+    return st, metrics
+
+
+TRACE_FIELDS = ("term", "role", "voted_for", "leader_id", "last_index",
+                "commit", "applied", "digest", "snap_index", "snap_term")
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def trace(cfg: RaftConfig, st: State, n_ticks: int, t0=0):
+    """Run `n_ticks` and return (state, trace) where trace is a dict of
+    stacked per-tick observables `[T, G, K]` — the fields `Cluster.snapshot`
+    exposes (cluster.py:141), for the differential gate. One device
+    program; no per-tick host round-trips."""
+
+    def body(s, t):
+        s = tick(cfg, s, t)
+        obs = {f: getattr(s.nodes, f) for f in TRACE_FIELDS}
+        obs["alive"] = s.alive_prev
+        return s, obs
+
+    return jax.lax.scan(body, st, t0 + jnp.arange(n_ticks, dtype=I32))
+
+
+def total_rounds(metrics: Metrics) -> int:
+    """Total consensus rounds = entries durably committed across groups.
+
+    Summed host-side in int64: at 10^5 groups x 10^4+ ticks the total
+    exceeds int32, and x64 is off on-device."""
+    return int(np.asarray(metrics.committed).astype(np.int64).sum())
+
+
+def latency_quantile(hist, q: float) -> int:
+    """q-quantile (in ticks) of the election-latency histogram, host-side."""
+    h = np.asarray(hist)
+    total = h.sum()
+    if total == 0:
+        return 0
+    cum = np.cumsum(h)
+    return int(np.searchsorted(cum, q * total, side="left"))

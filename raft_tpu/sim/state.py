@@ -1,0 +1,177 @@
+"""Struct-of-arrays state for the batched TPU path (DESIGN.md §5).
+
+Every field of the CPU oracle's `Node` (core/node.py) becomes an array with
+leading dims `[G, K]` (G = independent Raft groups, K = replicas per
+group). Logs are **ring-addressed by absolute index**: the entry at
+absolute index ``i`` lives in slot ``(i - 1) % L``. Because the window
+invariant ``last_index - snap_index <= L`` holds (DESIGN.md §3), the
+mapping is injective over the live window — so compaction and
+InstallSnapshot's keep-the-suffix case move ``snap_index`` without any
+data movement, and truncation is just lowering ``last_index``.
+
+The in-memory `Transport` (core/transport.py) becomes the dense `Mailbox`:
+one slot per (group, src, dst, message-type), exploiting the tick
+contract's guarantee of at most one message per (type, src, dst) per tick
+(DESIGN.md §2). `Mailbox` triples as the in-flight buffer (`[G, K, K]`
+leading dims), a node's inbox (`[K_src]` after transpose + vmap), and a
+node's outbox (`[K_dst]` inside the per-node step).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.node import FOLLOWER, NO_VOTE
+from raft_tpu.utils import jrng
+
+I32 = jnp.int32
+U32 = jnp.uint32
+BOOL = jnp.bool_
+
+
+class PerNode(NamedTuple):
+    """Per-replica state; one leaf per `Node` attribute (core/node.py).
+
+    Leading dims `[G, K]` in a full `State`; scalars / `[K]` / `[L]`
+    inside the vmapped per-node step.
+    """
+
+    # Durable (survives crash/restart — node.py:36-43).
+    term: jnp.ndarray         # i32
+    voted_for: jnp.ndarray    # i32, NO_VOTE = -1
+    snap_index: jnp.ndarray   # i32
+    snap_term: jnp.ndarray    # i32
+    snap_digest: jnp.ndarray  # u32
+    rng_draws: jnp.ndarray    # i32 — monotone deadline-draw counter
+    last_index: jnp.ndarray   # i32 (CPU: derived from len(log); explicit here)
+    log_term: jnp.ndarray     # i32[L], ring slot (i-1) % L
+    log_payload: jnp.ndarray  # i32[L]
+    # Volatile (reset on restart — node.py:45-57).
+    role: jnp.ndarray         # i32: FOLLOWER/CANDIDATE/LEADER
+    leader_id: jnp.ndarray    # i32
+    commit: jnp.ndarray       # i32
+    applied: jnp.ndarray      # i32
+    digest: jnp.ndarray       # u32 — state-machine hash chain
+    votes: jnp.ndarray        # bool[K]
+    next_index: jnp.ndarray   # i32[K]
+    match_index: jnp.ndarray  # i32[K]
+    election_elapsed: jnp.ndarray   # i32
+    heartbeat_elapsed: jnp.ndarray  # i32
+    deadline: jnp.ndarray     # i32
+
+
+class Mailbox(NamedTuple):
+    """One slot per (src, dst, rpc-type); fields mirror core/rpc.py.
+
+    Leading dims `[G, K_src, K_dst]` as the in-flight buffer. `*_present`
+    is the occupancy bit; all other fields are only meaningful under it.
+    """
+
+    rv_req_present: jnp.ndarray   # bool
+    rv_req_term: jnp.ndarray      # i32
+    rv_req_lli: jnp.ndarray       # i32 — last_log_index
+    rv_req_llt: jnp.ndarray       # i32 — last_log_term
+
+    rv_resp_present: jnp.ndarray  # bool
+    rv_resp_term: jnp.ndarray     # i32
+    rv_resp_granted: jnp.ndarray  # bool
+
+    ae_req_present: jnp.ndarray   # bool
+    ae_req_term: jnp.ndarray      # i32
+    ae_req_prev_index: jnp.ndarray  # i32
+    ae_req_prev_term: jnp.ndarray   # i32
+    ae_req_n: jnp.ndarray         # i32 — number of valid entries
+    ae_req_commit: jnp.ndarray    # i32 — leader_commit
+    ae_req_ent_term: jnp.ndarray     # i32[..., E]
+    ae_req_ent_payload: jnp.ndarray  # i32[..., E]
+
+    ae_resp_present: jnp.ndarray  # bool
+    ae_resp_term: jnp.ndarray     # i32
+    ae_resp_success: jnp.ndarray  # bool
+    ae_resp_match: jnp.ndarray    # i32
+
+    is_req_present: jnp.ndarray   # bool
+    is_req_term: jnp.ndarray      # i32
+    is_req_snap_index: jnp.ndarray   # i32
+    is_req_snap_term: jnp.ndarray    # i32
+    is_req_snap_digest: jnp.ndarray  # u32
+
+    is_resp_present: jnp.ndarray  # bool
+    is_resp_term: jnp.ndarray     # i32
+    is_resp_match: jnp.ndarray    # i32
+
+
+class State(NamedTuple):
+    nodes: PerNode        # leaves [G, K, ...]
+    mailbox: Mailbox      # in-flight: sent last tick, delivered this tick
+    alive_prev: jnp.ndarray  # bool[G, K] — liveness during the previous tick
+    group_id: jnp.ndarray    # i32[G] — GLOBAL group index. Carried in state
+    # (not derived from array positions) so that a device shard of the G
+    # axis keeps simulating its own groups' seed streams: inside shard_map
+    # an arange over the local shape would alias every shard onto groups
+    # [0, G_local), silently duplicating universes.
+
+
+def empty_mailbox(lead_shape: tuple, e: int) -> Mailbox:
+    """Zero mailbox with the given leading shape: `(g, k, k)` for the
+    in-flight buffer, `(k,)` for a per-node outbox inside the vmapped
+    step (entry fields get a trailing [E])."""
+    def z(dtype, *extra):
+        return jnp.zeros(tuple(lead_shape) + extra, dtype)
+
+    return Mailbox(
+        rv_req_present=z(BOOL), rv_req_term=z(I32), rv_req_lli=z(I32),
+        rv_req_llt=z(I32),
+        rv_resp_present=z(BOOL), rv_resp_term=z(I32), rv_resp_granted=z(BOOL),
+        ae_req_present=z(BOOL), ae_req_term=z(I32), ae_req_prev_index=z(I32),
+        ae_req_prev_term=z(I32), ae_req_n=z(I32), ae_req_commit=z(I32),
+        ae_req_ent_term=z(I32, e), ae_req_ent_payload=z(I32, e),
+        ae_resp_present=z(BOOL), ae_resp_term=z(I32), ae_resp_success=z(BOOL),
+        ae_resp_match=z(I32),
+        is_req_present=z(BOOL), is_req_term=z(I32), is_req_snap_index=z(I32),
+        is_req_snap_term=z(I32), is_req_snap_digest=z(U32),
+        is_resp_present=z(BOOL), is_resp_term=z(I32), is_resp_match=z(I32),
+    )
+
+
+def init(cfg: RaftConfig, n_groups: int | None = None) -> State:
+    """Fresh state bit-matching `Node.__init__` (node.py:28-57) per node."""
+    g = cfg.n_groups if n_groups is None else n_groups
+    k, cap = cfg.k, cfg.log_cap
+
+    g_idx = jnp.arange(g, dtype=I32)[:, None]          # [G, 1]
+    i_idx = jnp.arange(k, dtype=I32)[None, :]          # [1, K]
+    # __init__ runs _reset_election_timer once: deadline = draw 0, draws = 1.
+    deadline = jnp.broadcast_to(
+        jrng.election_deadline(cfg.seed, g_idx, i_idx, 0,
+                               cfg.election_min, cfg.election_range),
+        (g, k))
+
+    def z(dtype, *extra):
+        return jnp.zeros((g, k) + extra, dtype)
+
+    nodes = PerNode(
+        term=z(I32),
+        voted_for=jnp.full((g, k), NO_VOTE, I32),
+        snap_index=z(I32), snap_term=z(I32), snap_digest=z(U32),
+        rng_draws=jnp.ones((g, k), I32),
+        last_index=z(I32),
+        log_term=z(I32, cap), log_payload=z(I32, cap),
+        role=jnp.full((g, k), FOLLOWER, I32),
+        leader_id=jnp.full((g, k), NO_VOTE, I32),
+        commit=z(I32), applied=z(I32), digest=z(U32),
+        votes=z(BOOL, k),
+        next_index=jnp.ones((g, k, k), I32),
+        match_index=z(I32, k),
+        election_elapsed=z(I32), heartbeat_elapsed=z(I32),
+        deadline=deadline,
+    )
+    return State(
+        nodes=nodes,
+        mailbox=empty_mailbox((g, k, k), cfg.max_entries_per_msg),
+        alive_prev=jnp.ones((g, k), BOOL),
+        group_id=jnp.arange(g, dtype=I32),
+    )

@@ -1,0 +1,552 @@
+"""The batched TPU tick: `core/node.py` + `core/transport.py` as pure array ops.
+
+Every handler here mirrors a method of the CPU oracle `Node`
+branch-for-branch (mask-for-branch); the differential suite
+(`tests/test_differential.py`) holds the two bit-identical per node per
+tick. Handlers are written for ONE node — scalar state fields, `[K]`
+peer vectors, `[L]` log rings, an inbox with a `[K_src]` leading axis —
+and lifted with `vmap` over the node axis then the group axis
+(DESIGN.md §5). The sequential tick contract (DESIGN.md §2: canonical
+(type, src) inbox order) becomes a statically unrolled chain of masked
+handler applications: 6 message types x K senders, each application
+fully vectorized over the [G, K] batch, which is where the parallelism
+lives. No data-dependent control flow anywhere — everything is
+`jnp.where`.
+
+Faults (DESIGN.md §4) are applied at the batch level: the delivery
+filter masks mailbox occupancy bits, crash masks freeze dead nodes'
+state wholesale and erase their outbox, and the dead->alive edge applies
+`Node.restart()` semantics (durable survives, volatile rewinds).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.node import CANDIDATE, FOLLOWER, LEADER, NO_VOTE
+from raft_tpu.ops import quorum
+from raft_tpu.sim.state import (BOOL, I32, Mailbox, PerNode, State,
+                                empty_mailbox)
+from raft_tpu.utils import jrng
+
+# --------------------------------------------------------------- log helpers
+# Ring addressing: absolute index i lives in slot (i - 1) % L. See
+# sim/state.py module docstring for why this is injective over the window.
+
+
+def _slot(cfg: RaftConfig, idx):
+    return (idx - 1) % cfg.log_cap
+
+
+def _term_at(cfg, ns: PerNode, idx):
+    """`Node.term_at` (node.py:65). Valid for snap_index <= idx <= last_index;
+    masked garbage outside that range (callers guard)."""
+    return jnp.where(idx == ns.snap_index, ns.snap_term,
+                     ns.log_term[_slot(cfg, idx)])
+
+
+def _payload_at(cfg, ns: PerNode, idx):
+    return ns.log_payload[_slot(cfg, idx)]
+
+
+def _last_log_term(cfg, ns: PerNode):
+    return _term_at(cfg, ns, ns.last_index)
+
+
+def _put(arr, p: int, cond, val):
+    """Masked write of outbox slot p (p is a static unroll index)."""
+    return arr.at[p].set(jnp.where(cond, val, arr[p]))
+
+
+# -------------------------------------------------------------- transitions
+
+
+def _reset_timer(cfg, ns: PerNode, g, i, cond):
+    """`Node._reset_election_timer` (node.py:89): one counted draw."""
+    deadline = jrng.election_deadline(cfg.seed, g, i, ns.rng_draws,
+                                      cfg.election_min, cfg.election_range)
+    return ns._replace(
+        election_elapsed=jnp.where(cond, 0, ns.election_elapsed),
+        deadline=jnp.where(cond, deadline, ns.deadline),
+        rng_draws=ns.rng_draws + cond.astype(I32),
+    )
+
+
+def _step_down(ns: PerNode, new_term, cond):
+    """`Node._step_down` (node.py:96): adopt term, follower, no timer reset."""
+    return ns._replace(
+        term=jnp.where(cond, new_term, ns.term),
+        role=jnp.where(cond, FOLLOWER, ns.role),
+        voted_for=jnp.where(cond, NO_VOTE, ns.voted_for),
+        leader_id=jnp.where(cond, NO_VOTE, ns.leader_id),
+        votes=jnp.where(cond, False, ns.votes),
+    )
+
+
+def _become_leader(cfg, ns: PerNode, i, cond):
+    """`Node._become_leader` (node.py:104) incl. the takeover re-proposal
+    (DESIGN.md §2a): the TOP entry takes the new term in place."""
+    ns = ns._replace(
+        role=jnp.where(cond, LEADER, ns.role),
+        leader_id=jnp.where(cond, i, ns.leader_id),
+        next_index=jnp.where(cond, ns.last_index + 1, ns.next_index),
+        match_index=jnp.where(cond, 0, ns.match_index),
+        heartbeat_elapsed=jnp.where(cond, cfg.heartbeat_every,
+                                    ns.heartbeat_elapsed),
+    )
+    top = cond & (ns.last_index > ns.commit)
+    s = _slot(cfg, ns.last_index)
+    return ns._replace(
+        log_term=ns.log_term.at[s].set(
+            jnp.where(top, ns.term, ns.log_term[s])))
+
+
+def _accept_leader(cfg, ns: PerNode, g, i, src: int, cond):
+    """`Node._accept_leader` (node.py:194)."""
+    ns = ns._replace(
+        role=jnp.where(cond, FOLLOWER, ns.role),
+        leader_id=jnp.where(cond, src, ns.leader_id),
+        votes=jnp.where(cond, False, ns.votes),
+    )
+    return _reset_timer(cfg, ns, g, i, cond)
+
+
+# ----------------------------------------------------------------- phase D
+
+
+def _on_rv_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
+    """`Node._on_rv_req` (node.py:169)."""
+    present = ib.rv_req_present[src]
+    m_term, m_lli, m_llt = (ib.rv_req_term[src], ib.rv_req_lli[src],
+                            ib.rv_req_llt[src])
+    ns = _step_down(ns, m_term, present & (m_term > ns.term))
+    llt = _last_log_term(cfg, ns)
+    log_ok = (m_llt > llt) | ((m_llt == llt) & (m_lli >= ns.last_index))
+    grant = (present & (m_term == ns.term)
+             & ((ns.voted_for == NO_VOTE) | (ns.voted_for == src))
+             & log_ok)
+    ns = ns._replace(voted_for=jnp.where(grant, src, ns.voted_for))
+    ns = _reset_timer(cfg, ns, g, i, grant)
+    out = out._replace(
+        rv_resp_present=_put(out.rv_resp_present, src, present, True),
+        rv_resp_term=_put(out.rv_resp_term, src, present, ns.term),
+        rv_resp_granted=_put(out.rv_resp_granted, src, present, grant),
+    )
+    return ns, out
+
+
+def _on_rv_resp(cfg, ns, out, g, i, src: int, ib: Mailbox):
+    """`Node._on_rv_resp` (node.py:184)."""
+    present = ib.rv_resp_present[src]
+    m_term, m_granted = ib.rv_resp_term[src], ib.rv_resp_granted[src]
+    higher = present & (m_term > ns.term)
+    ns = _step_down(ns, m_term, higher)
+    cont = (present & ~higher & (ns.role == CANDIDATE)
+            & (m_term == ns.term) & m_granted)
+    votes = ns.votes.at[src].set(ns.votes[src] | cont)
+    ns = ns._replace(votes=votes)
+    won = cont & (quorum.vote_count(votes) >= cfg.majority)
+    return _become_leader(cfg, ns, i, won), out
+
+
+def _on_ae_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
+    """`Node._on_ae_req` (node.py:201): the log-matching workhorse."""
+    present = ib.ae_req_present[src]
+    m_term = ib.ae_req_term[src]
+    m_prev = ib.ae_req_prev_index[src]
+    m_prev_term = ib.ae_req_prev_term[src]
+    m_n = ib.ae_req_n[src]
+    m_commit = ib.ae_req_commit[src]
+    ent_t = ib.ae_req_ent_term[src]       # [E]
+    ent_p = ib.ae_req_ent_payload[src]    # [E]
+
+    ns = _step_down(ns, m_term, present & (m_term > ns.term))
+    stale = present & (m_term < ns.term)
+    ok = present & ~stale
+    ns = _accept_leader(cfg, ns, g, i, src, ok)
+
+    past = ok & (m_prev > ns.last_index)
+    conflict = (ok & ~past & (m_prev >= ns.snap_index)
+                & (_term_at(cfg, ns, m_prev) != m_prev_term))
+    # Fast-backup walk to the first index of the conflicting term
+    # (node.py:219-223), unrolled over the window bound L.
+    ct = _term_at(cfg, ns, m_prev)
+    ci = m_prev
+    for _ in range(cfg.log_cap):
+        step = (conflict & (ci - 1 > ns.snap_index)
+                & (ns.log_term[_slot(cfg, ci - 1)] == ct))
+        ci = jnp.where(step, ci - 1, ci)
+
+    proceed = ok & ~past & ~conflict
+    # Entry walk (node.py:229-256). Entries at idx <= snap_index are
+    # committed here hence match (Log Matching) — skipped via j0.
+    j0 = jnp.maximum(0, ns.snap_index - m_prev)
+    hi = m_prev + j0
+    last_index = ns.last_index
+    log_term, log_payload = ns.log_term, ns.log_payload
+    stopped = jnp.zeros((), BOOL)
+    for j in range(cfg.max_entries_per_msg):
+        idx = m_prev + 1 + j
+        act = proceed & (j >= j0) & (j < m_n) & ~stopped
+        s = _slot(cfg, idx)
+        in_log = act & (idx <= last_index)
+        # act => idx > snap_index, so a direct slot read IS term_at(idx).
+        same_t = in_log & (log_term[s] == ent_t[j])
+        same_p = in_log & ~same_t & (log_payload[s] == ent_p[j])
+        diverge = in_log & ~same_t & ~same_p   # truncate, then append
+        need_append = (act & ~in_log) | diverge
+        room = (idx - ns.snap_index) <= cfg.log_cap
+        do_append = need_append & room
+        log_term = log_term.at[s].set(
+            jnp.where(same_p | do_append, ent_t[j], log_term[s]))
+        log_payload = log_payload.at[s].set(
+            jnp.where(do_append, ent_p[j], log_payload[s]))
+        # Truncation (divergent suffix) is just lowering last_index in the
+        # ring model; append then restores it to idx when there is room.
+        last_index = jnp.where(
+            do_append, idx,
+            jnp.where(diverge & ~room, idx - 1, last_index))
+        stopped = stopped | (need_append & ~room)
+        hi = jnp.where(same_t | same_p | do_append, idx, hi)
+
+    commit = jnp.where(
+        proceed & (m_commit > ns.commit),
+        jnp.maximum(ns.commit, jnp.minimum(m_commit, hi)),
+        ns.commit)
+    ns = ns._replace(log_term=log_term, log_payload=log_payload,
+                     last_index=last_index, commit=commit)
+
+    match = jnp.where(
+        past, last_index + 1,
+        jnp.where(conflict, ci, jnp.where(proceed, hi, 0)))
+    out = out._replace(
+        ae_resp_present=_put(out.ae_resp_present, src, present, True),
+        ae_resp_term=_put(out.ae_resp_term, src, present, ns.term),
+        ae_resp_success=_put(out.ae_resp_success, src, present, proceed),
+        ae_resp_match=_put(out.ae_resp_match, src, present, match),
+    )
+    return ns, out
+
+
+def _on_ae_resp(cfg, ns, out, g, i, src: int, ib: Mailbox):
+    """`Node._on_ae_resp` (node.py:263)."""
+    present = ib.ae_resp_present[src]
+    m_term = ib.ae_resp_term[src]
+    m_success = ib.ae_resp_success[src]
+    m_match = ib.ae_resp_match[src]
+    higher = present & (m_term > ns.term)
+    ns = _step_down(ns, m_term, higher)
+    cont = present & ~higher & (ns.role == LEADER) & (m_term == ns.term)
+    succ = cont & m_success
+    fail = cont & ~m_success
+    new_match = jnp.maximum(ns.match_index[src], m_match)
+    match_index = ns.match_index.at[src].set(
+        jnp.where(succ, new_match, ns.match_index[src]))
+    next_index = ns.next_index.at[src].set(jnp.where(
+        succ, new_match + 1,
+        jnp.where(fail,
+                  jnp.maximum(1, jnp.minimum(ns.next_index[src] - 1, m_match)),
+                  ns.next_index[src])))
+    return ns._replace(match_index=match_index, next_index=next_index), out
+
+
+def _on_is_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
+    """`Node._on_is_req` (node.py:275)."""
+    present = ib.is_req_present[src]
+    m_term = ib.is_req_term[src]
+    m_si = ib.is_req_snap_index[src]
+    m_st = ib.is_req_snap_term[src]
+    m_sd = ib.is_req_snap_digest[src]
+    ns = _step_down(ns, m_term, present & (m_term > ns.term))
+    stale = present & (m_term < ns.term)
+    ok = present & ~stale
+    ns = _accept_leader(cfg, ns, g, i, src, ok)
+    have = ok & (m_si <= ns.commit)   # already covered (node.py:283)
+    inst = ok & ~have
+    # Keep-the-suffix test (node.py:288-293). In the ring model keeping the
+    # suffix means last_index is simply left alone (slots are absolute).
+    keep = (inst & (m_si <= ns.last_index) & (m_si >= ns.snap_index)
+            & (_term_at(cfg, ns, jnp.maximum(m_si, ns.snap_index)) == m_st))
+    ns = ns._replace(
+        last_index=jnp.where(inst, jnp.where(keep, ns.last_index, m_si),
+                             ns.last_index),
+        snap_index=jnp.where(inst, m_si, ns.snap_index),
+        snap_term=jnp.where(inst, m_st, ns.snap_term),
+        snap_digest=jnp.where(inst, m_sd, ns.snap_digest),
+        commit=jnp.where(inst, m_si, ns.commit),
+        applied=jnp.where(inst, m_si, ns.applied),
+        digest=jnp.where(inst, m_sd, ns.digest),
+    )
+    match = jnp.where(stale, 0, jnp.where(have, ns.commit, m_si))
+    out = out._replace(
+        is_resp_present=_put(out.is_resp_present, src, present, True),
+        is_resp_term=_put(out.is_resp_term, src, present, ns.term),
+        is_resp_match=_put(out.is_resp_match, src, present, match),
+    )
+    return ns, out
+
+
+def _on_is_resp(cfg, ns, out, g, i, src: int, ib: Mailbox):
+    """`Node._on_is_resp` (node.py:305)."""
+    present = ib.is_resp_present[src]
+    m_term = ib.is_resp_term[src]
+    m_match = ib.is_resp_match[src]
+    higher = present & (m_term > ns.term)
+    ns = _step_down(ns, m_term, higher)
+    cont = present & ~higher & (ns.role == LEADER) & (m_term == ns.term)
+    new_match = jnp.maximum(ns.match_index[src], m_match)
+    match_index = ns.match_index.at[src].set(
+        jnp.where(cont, new_match, ns.match_index[src]))
+    next_index = ns.next_index.at[src].set(
+        jnp.where(cont, new_match + 1, ns.next_index[src]))
+    return ns._replace(match_index=match_index, next_index=next_index), out
+
+
+_HANDLERS = (_on_rv_req, _on_rv_resp, _on_ae_req, _on_ae_resp,
+             _on_is_req, _on_is_resp)   # canonical rpc type order
+
+
+# ----------------------------------------------------------------- phase T
+
+
+def _phase_t(cfg, ns, out, g, i):
+    """`Node.phase_t` (node.py:316) + `_broadcast_append` (node.py:327)
+    + `_start_election` (node.py:122)."""
+    is_leader = ns.role == LEADER
+    hb = ns.heartbeat_elapsed + 1
+    fire = is_leader & (hb >= cfg.heartbeat_every)
+    ns = ns._replace(heartbeat_elapsed=jnp.where(
+        is_leader, jnp.where(fire, 0, hb), ns.heartbeat_elapsed))
+
+    for p in range(cfg.k):
+        cond = fire & (i != p)
+        use_is = cond & (ns.next_index[p] <= ns.snap_index)
+        use_ae = cond & (ns.next_index[p] > ns.snap_index)
+        out = out._replace(
+            is_req_present=_put(out.is_req_present, p, use_is, True),
+            is_req_term=_put(out.is_req_term, p, use_is, ns.term),
+            is_req_snap_index=_put(out.is_req_snap_index, p, use_is,
+                                   ns.snap_index),
+            is_req_snap_term=_put(out.is_req_snap_term, p, use_is,
+                                  ns.snap_term),
+            is_req_snap_digest=_put(out.is_req_snap_digest, p, use_is,
+                                    ns.snap_digest),
+        )
+        prev = ns.next_index[p] - 1
+        n = jnp.minimum(cfg.max_entries_per_msg, ns.last_index - prev)
+        ents_t, ents_p = [], []
+        for j in range(cfg.max_entries_per_msg):
+            idx = prev + 1 + j
+            valid = use_ae & (j < n)
+            s = _slot(cfg, idx)
+            ents_t.append(jnp.where(valid, ns.log_term[s], 0))
+            ents_p.append(jnp.where(valid, ns.log_payload[s], 0))
+        out = out._replace(
+            ae_req_present=_put(out.ae_req_present, p, use_ae, True),
+            ae_req_term=_put(out.ae_req_term, p, use_ae, ns.term),
+            ae_req_prev_index=_put(out.ae_req_prev_index, p, use_ae, prev),
+            ae_req_prev_term=_put(out.ae_req_prev_term, p, use_ae,
+                                  _term_at(cfg, ns, prev)),
+            ae_req_n=_put(out.ae_req_n, p, use_ae, n),
+            ae_req_commit=_put(out.ae_req_commit, p, use_ae, ns.commit),
+            ae_req_ent_term=_put(out.ae_req_ent_term, p, use_ae,
+                                 jnp.stack(ents_t)),
+            ae_req_ent_payload=_put(out.ae_req_ent_payload, p, use_ae,
+                                    jnp.stack(ents_p)),
+        )
+
+    # Election timeout (non-leaders).
+    ee = ns.election_elapsed + 1
+    timeout = ~is_leader & (ee >= ns.deadline)
+    ns = ns._replace(election_elapsed=jnp.where(is_leader,
+                                                ns.election_elapsed, ee))
+    ns = ns._replace(
+        term=jnp.where(timeout, ns.term + 1, ns.term),
+        role=jnp.where(timeout, CANDIDATE, ns.role),
+        voted_for=jnp.where(timeout, i, ns.voted_for),
+        leader_id=jnp.where(timeout, NO_VOTE, ns.leader_id),
+        votes=jnp.where(timeout, jnp.arange(cfg.k) == i, ns.votes),
+    )
+    ns = _reset_timer(cfg, ns, g, i, timeout)
+    if cfg.majority == 1:
+        ns = _become_leader(cfg, ns, i, timeout)
+    else:
+        llt = _last_log_term(cfg, ns)
+        for p in range(cfg.k):
+            cond = timeout & (i != p)
+            out = out._replace(
+                rv_req_present=_put(out.rv_req_present, p, cond, True),
+                rv_req_term=_put(out.rv_req_term, p, cond, ns.term),
+                rv_req_lli=_put(out.rv_req_lli, p, cond, ns.last_index),
+                rv_req_llt=_put(out.rv_req_llt, p, cond, llt),
+            )
+    return ns, out
+
+
+# ----------------------------------------------------------------- phase C
+
+
+def _phase_c(cfg, ns, g):
+    """`Node.phase_c` (node.py:348): leader appends client commands."""
+    lead = ns.role == LEADER
+    last_index = ns.last_index
+    log_term, log_payload = ns.log_term, ns.log_payload
+    stopped = jnp.zeros((), BOOL)
+    for _ in range(cfg.cmds_per_tick):
+        idx = last_index + 1
+        room = (idx - ns.snap_index) <= cfg.log_cap
+        do = lead & room & ~stopped
+        payload = jrng.client_payload(cfg.seed, g, ns.term, idx)
+        s = _slot(cfg, idx)
+        log_term = log_term.at[s].set(jnp.where(do, ns.term, log_term[s]))
+        log_payload = log_payload.at[s].set(
+            jnp.where(do, payload, log_payload[s]))
+        last_index = jnp.where(do, idx, last_index)
+        stopped = stopped | (lead & ~room)
+    return ns._replace(last_index=last_index, log_term=log_term,
+                       log_payload=log_payload)
+
+
+# ----------------------------------------------------------------- phase A
+
+
+def _phase_a(cfg, ns, i):
+    """`Node.phase_a` (node.py:359): commit advance, apply, compact."""
+    n = quorum.commit_candidate(ns.match_index, ns.last_index, i,
+                                cfg.k, cfg.majority)
+    # §5.4.2: current-term entries only. n > commit >= snap_index makes the
+    # term_at read valid under the mask.
+    advance = ((ns.role == LEADER) & (n > ns.commit)
+               & (_term_at(cfg, ns, n) == ns.term))
+    commit = jnp.where(advance, n, ns.commit)
+
+    # Apply loop: commit - applied <= L by the window invariant, so an
+    # L-step unrolled chain covers it. The digest chain is inherently
+    # sequential (node.py:369-374).
+    applied, digest = ns.applied, ns.digest
+    for _ in range(cfg.log_cap):
+        idx = applied + 1
+        act = idx <= commit
+        digest = jnp.where(
+            act, jrng.digest_update(digest, idx, _payload_at(cfg, ns, idx)),
+            digest)
+        applied = jnp.where(act, idx, applied)
+
+    compact = (commit - ns.snap_index) >= cfg.compact_every
+    return ns._replace(
+        commit=commit, applied=applied, digest=digest,
+        snap_term=jnp.where(compact, _term_at(cfg, ns, commit), ns.snap_term),
+        snap_index=jnp.where(compact, commit, ns.snap_index),
+        snap_digest=jnp.where(compact, digest, ns.snap_digest),
+    )
+
+
+# ------------------------------------------------------------ per-node tick
+
+
+def _node_tick(cfg, ns: PerNode, inbox: Mailbox, g, i):
+    """One node's full D/T/C/A tick. `inbox` leaves lead with [K_src];
+    the returned outbox leaves lead with [K_dst]."""
+    out = empty_mailbox((cfg.k,), cfg.max_entries_per_msg)
+    # Phase D: canonical (type, src) order — node.py:154 + rpc.sort_inbox.
+    for handler in _HANDLERS:
+        for src in range(cfg.k):
+            ns, out = handler(cfg, ns, out, g, i, src, inbox)
+    ns, out = _phase_t(cfg, ns, out, g, i)
+    ns = _phase_c(cfg, ns, g)
+    ns = _phase_a(cfg, ns, i)
+    return ns, out
+
+
+# ------------------------------------------------------------- global tick
+
+
+def _apply_restart(cfg, nodes: PerNode, g_grid, i_grid, edge):
+    """`Node.restart` (node.py:139): durable survives, volatile rewinds."""
+    new_deadline = jrng.election_deadline(cfg.seed, g_grid, i_grid,
+                                          nodes.rng_draws, cfg.election_min,
+                                          cfg.election_range)
+    e1 = edge[..., None]
+    return nodes._replace(
+        role=jnp.where(edge, FOLLOWER, nodes.role),
+        leader_id=jnp.where(edge, NO_VOTE, nodes.leader_id),
+        commit=jnp.where(edge, nodes.snap_index, nodes.commit),
+        applied=jnp.where(edge, nodes.snap_index, nodes.applied),
+        digest=jnp.where(edge, nodes.snap_digest, nodes.digest),
+        votes=jnp.where(e1, False, nodes.votes),
+        next_index=jnp.where(e1, 1, nodes.next_index),
+        match_index=jnp.where(e1, 0, nodes.match_index),
+        heartbeat_elapsed=jnp.where(edge, 0, nodes.heartbeat_elapsed),
+        election_elapsed=jnp.where(edge, 0, nodes.election_elapsed),
+        deadline=jnp.where(edge, new_deadline, nodes.deadline),
+        rng_draws=nodes.rng_draws + edge.astype(I32),
+    )
+
+
+def _filter_mailbox(cfg, mb: Mailbox, t, alive_now, group_id) -> Mailbox:
+    """`Transport.deliver`'s fault filter (transport.py:35): dead
+    destinations, partitioned links, dropped links."""
+    g, k = alive_now.shape
+    gg = group_id[:, None, None]
+    src = jnp.arange(k, dtype=I32)[None, :, None]
+    dst = jnp.arange(k, dtype=I32)[None, None, :]
+    part = jrng.link_partitioned(cfg.seed, gg, t, src, dst,
+                                 cfg.partition_u32, cfg.partition_epoch)
+    drop = jrng.link_dropped(cfg.seed, gg, t, src, dst, cfg.drop_u32)
+    keep = alive_now[:, None, :] & ~part & ~drop
+    return mb._replace(
+        rv_req_present=mb.rv_req_present & keep,
+        rv_resp_present=mb.rv_resp_present & keep,
+        ae_req_present=mb.ae_req_present & keep,
+        ae_resp_present=mb.ae_resp_present & keep,
+        is_req_present=mb.is_req_present & keep,
+        is_resp_present=mb.is_resp_present & keep,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def tick(cfg: RaftConfig, st: State, t) -> State:
+    """One global tick over all [G, K] replicas: `Cluster.tick`
+    (cluster.py:100) vectorized. `t` is the absolute tick counter (traced;
+    fault schedules hash it)."""
+    g, k = st.alive_prev.shape
+    g_grid = jnp.broadcast_to(st.group_id[:, None], (g, k))
+    i_grid = jnp.broadcast_to(jnp.arange(k, dtype=I32)[None, :], (g, k))
+
+    alive_now = jnp.broadcast_to(
+        jrng.node_alive(cfg.seed, g_grid, i_grid, t,
+                        cfg.crash_u32, cfg.crash_epoch), (g, k))
+    nodes = _apply_restart(cfg, st.nodes, g_grid, i_grid,
+                           alive_now & ~st.alive_prev)
+
+    inbox = _filter_mailbox(cfg, st.mailbox, t, alive_now, st.group_id)
+    # [G, src, dst, ...] -> [G, dst, src, ...] so vmap over the node axis
+    # hands each node its per-sender inbox.
+    inbox_t = jax.tree.map(lambda a: jnp.swapaxes(a, 1, 2), inbox)
+
+    node_fn = functools.partial(_node_tick, cfg)
+    new_nodes, outbox = jax.vmap(jax.vmap(node_fn))(nodes, inbox_t,
+                                                    g_grid, i_grid)
+
+    # Dead nodes: state frozen, sends erased (cluster.py:103-119 runs no
+    # phase for them; transport keeps their in-flight mail).
+    def freeze(new, old):
+        m = alive_now.reshape(alive_now.shape + (1,) * (new.ndim - 2))
+        return jnp.where(m, new, old)
+
+    new_nodes = jax.tree.map(freeze, new_nodes, nodes)
+    src_alive = alive_now[:, :, None]
+    outbox = outbox._replace(
+        rv_req_present=outbox.rv_req_present & src_alive,
+        rv_resp_present=outbox.rv_resp_present & src_alive,
+        ae_req_present=outbox.ae_req_present & src_alive,
+        ae_resp_present=outbox.ae_resp_present & src_alive,
+        is_req_present=outbox.is_req_present & src_alive,
+        is_resp_present=outbox.is_resp_present & src_alive,
+    )
+    return State(nodes=new_nodes, mailbox=outbox, alive_prev=alive_now,
+                 group_id=st.group_id)

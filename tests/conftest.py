@@ -1,16 +1,24 @@
 """Force tests onto a virtual 8-device CPU platform.
 
-Must run before `import jax` anywhere in the test process: the driver's
-multi-chip validation uses the same mechanism
-(xla_force_host_platform_device_count), and tests must not depend on real
-TPU hardware being attached.
+Two mechanisms, both needed:
+- XLA_FLAGS must be set before `import jax` so the host platform splits
+  into 8 virtual devices (the driver's multi-chip validation uses the
+  same xla_force_host_platform_device_count mechanism).
+- The TPU PJRT plugin in this image ignores the JAX_PLATFORMS env var
+  (verified: with JAX_PLATFORMS=cpu the default backend stays 'tpu'), so
+  the backend must be pinned via jax.config after import. Tests must not
+  depend on real TPU hardware being attached; bench.py is the TPU job.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (import must follow the env setup above)
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,41 @@
+"""Cross-group seed independence on the CPU oracle (VERDICT round-1
+item 8): distinct group ids must yield distinct schedules and payloads
+from the same config+seed, with all safety invariants intact — the
+oracle's last blind spot before it certifies a 10^5-group sim."""
+
+from __future__ import annotations
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.cluster import Cluster
+from raft_tpu.utils import rng
+
+
+def test_groups_draw_distinct_schedules():
+    cfg = RaftConfig(seed=3)
+    deadlines = [
+        [rng.election_deadline(cfg.seed, g, i, 0, cfg.election_min,
+                               cfg.election_range) for i in range(cfg.k)]
+        for g in range(4)]
+    # Not a permutation accident: the full per-group vectors must differ.
+    assert len({tuple(d) for d in deadlines}) == 4
+    payloads = [rng.client_payload(cfg.seed, g, 1, 1) for g in range(4)]
+    assert len(set(payloads)) == 4
+
+
+def test_multi_group_runs_diverge_and_stay_safe():
+    cfg = RaftConfig(seed=5, drop_prob=0.1, crash_prob=0.2, crash_epoch=48)
+    clusters = [Cluster(cfg, group=g) for g in range(3)]
+    for c in clusters:
+        c.run(500)  # Cluster.tick raises SafetyViolation on any breach
+    digests = [max(n.digest for n in c.nodes) for c in clusters]
+    commits = [max(n.commit for n in c.nodes) for c in clusters]
+    assert all(x > 0 for x in commits)
+    # Groups consumed different payload streams -> different state machines.
+    assert len(set(digests)) == 3
+    # Fault schedules differ across groups: crash epochs shouldn't align.
+    alive_patterns = {
+        tuple(rng.node_alive(cfg.seed, g, i, t, cfg.crash_u32,
+                             cfg.crash_epoch)
+              for i in range(cfg.k) for t in range(0, 480, 48))
+        for g in range(3)}
+    assert len(alive_patterns) == 3
