@@ -64,10 +64,12 @@ class PerNode(NamedTuple):
 
 
 class Mailbox(NamedTuple):
-    """One slot per (src, dst, rpc-type); fields mirror core/rpc.py.
+    """One slot per (dst, src, rpc-type); fields mirror core/rpc.py.
 
-    Leading dims `[G, K_src, K_dst]` as the in-flight buffer. `*_present`
-    is the occupancy bit; all other fields are only meaningful under it.
+    Leading dims `[G, K_dst, K_src]` as the in-flight buffer — receiver-
+    major, so the per-node vmap slices each node's per-sender inbox with
+    no transpose (see sim/step.py `tick`). `*_present` is the occupancy
+    bit; all other fields are only meaningful under it.
     """
 
     rv_req_present: jnp.ndarray   # bool
@@ -117,8 +119,8 @@ class State(NamedTuple):
 
 def empty_mailbox(lead_shape: tuple, e: int) -> Mailbox:
     """Zero mailbox with the given leading shape: `(g, k, k)` for the
-    in-flight buffer, `(k,)` for a per-node outbox inside the vmapped
-    step (entry fields get a trailing [E])."""
+    in-flight buffer ([G, dst, src]), `(k,)` for a per-node outbox inside
+    the vmapped step (entry fields get a trailing [E])."""
     def z(dtype, *extra):
         return jnp.zeros(tuple(lead_shape) + extra, dtype)
 
