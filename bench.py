@@ -11,7 +11,9 @@ replication — timed after a warmup run that absorbs compilation and the
 initial elections (compile time excluded per VERDICT round-1 item 3).
 Election latency (p50/p99, in ticks) comes from a fault-injected run
 (config-4 shape: leader crashes + partitions at 50K groups) where
-elections actually keep happening; per-phase detail goes to stderr.
+elections actually keep happening. The config-2 shape — pure
+leader-election rounds, no client commands — reports elections/sec at
+10K groups under constant crash churn. Per-phase detail goes to stderr.
 """
 
 from __future__ import annotations
@@ -96,6 +98,35 @@ def bench_elections(n_groups: int, ticks: int):
     return p50, p99, int(m.elections)
 
 
+def bench_election_rounds(n_groups: int, ticks: int, warmup_chunks: int = 1):
+    """Config 2 shape: pure leader-election rounds — no client commands
+    (`cmds_per_tick=0`, so no AppendEntries payload traffic and commits
+    stay 0), with constant crash churn so elections keep completing.
+    Reports completed leader acquisitions per second."""
+    cfg = RaftConfig(seed=44, cmds_per_tick=0, crash_prob=0.5,
+                     crash_epoch=32)
+    st = sim.init(cfg, n_groups=n_groups)
+    m = metrics_init(n_groups)
+    tick_at = 0
+    for _ in range(warmup_chunks):
+        st, m = sim.run(cfg, st, CHUNK, tick_at, m)
+        tick_at += CHUNK
+    jax.block_until_ready(st)
+    base = int(m.elections)
+    n_chunks = max(1, ticks // CHUNK)
+    start = time.perf_counter()
+    for _ in range(n_chunks):
+        st, m = sim.run(cfg, st, CHUNK, tick_at, m)
+        tick_at += CHUNK
+    jax.block_until_ready(st)
+    elapsed = time.perf_counter() - start
+    elections = int(m.elections) - base
+    eps = elections / elapsed
+    log(f"  election rounds {n_groups} groups x {n_chunks * CHUNK} ticks: "
+        f"{elections} elections in {elapsed:.2f}s -> {eps:,.0f} elections/s")
+    return eps, elections
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -110,19 +141,19 @@ def main():
     if args.quick:
         groups, ticks = 1_000, 200
         e_groups, e_ticks = 1_000, 200
+        r_groups, r_ticks = 1_000, 200
     else:
-        # NOTE: the config-5 target shape is 100K groups; at 100K the
-        # current program triggers a TPU-runtime device error (kernel
-        # fault) on this chip, so the headline runs at 50K until the hot
-        # path is restructured — rounds/sec/chip is batch-size-neutral
-        # once the VPU is saturated.
-        groups, ticks = args.groups or 50_000, 600
-        e_groups, e_ticks = 20_000, 600
+        # The headline runs at the true config-5 shape: 100K groups.
+        groups, ticks = args.groups or 100_000, 600
+        e_groups, e_ticks = 50_000, 600      # config-4 shape
+        r_groups, r_ticks = 10_000, 600      # config-2 shape
 
     log(f"throughput (config-5 shape, {groups} x 5-node groups):")
     rps, rounds, elapsed, ticks = bench_throughput(groups, ticks)
     log("election latency (config-4 shape):")
     p50, p99, n_elections = bench_elections(e_groups, e_ticks)
+    log("election rounds (config-2 shape):")
+    eps, rounds_elections = bench_election_rounds(r_groups, r_ticks)
 
     print(json.dumps({
         "metric": "consensus_rounds_per_sec_per_chip",
@@ -135,6 +166,7 @@ def main():
         "p50_election_latency_ticks": p50,
         "p99_election_latency_ticks": p99,
         "elections_observed": n_elections,
+        "elections_per_sec": round(eps, 1),
         "device": f"{dev.platform}:{dev.device_kind}",
     }))
 
