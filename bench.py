@@ -28,7 +28,8 @@ import numpy as np
 
 from raft_tpu import sim
 from raft_tpu.config import RaftConfig
-from raft_tpu.sim.run import latency_quantile, metrics_init, total_rounds
+from raft_tpu.sim.run import (latency_censored, latency_quantile,
+                              metrics_init, total_rounds)
 
 BASELINE_ROUNDS_PER_SEC = 1_000_000.0
 
@@ -92,17 +93,33 @@ def bench_elections(n_groups: int, ticks: int):
     elapsed = time.perf_counter() - t0
     p50 = latency_quantile(m.hist, 0.5)
     p99 = latency_quantile(m.hist, 0.99)
+    censored = latency_censored(m.hist, 0.99)
+    max_lat = int(m.max_latency)
     log(f"  fault run {n_groups} groups x {ticks} ticks in {elapsed:.1f}s "
         f"(incl. compile): {int(m.elections)} elections, "
-        f"p50={p50} p99={p99} ticks")
-    return p50, p99, int(m.elections)
+        f"p50={p50} p99={p99} max={max_lat} ticks"
+        f"{' [p99 CENSORED at histogram top bucket]' if censored else ''}")
+    return p50, p99, int(m.elections), censored, max_lat
 
 
 def bench_election_rounds(n_groups: int, ticks: int, warmup_chunks: int = 1):
     """Config 2 shape: pure leader-election rounds — no client commands
     (`cmds_per_tick=0`, so no AppendEntries payload traffic and commits
     stay 0), with constant crash churn so elections keep completing.
-    Reports completed leader acquisitions per second."""
+    Reports completed leader acquisitions per second.
+
+    What the number means: elections only complete when the crash
+    schedule deposes a leader, so the measured rate is bounded above by
+    the schedule's leader-crash rate, NOT by an intrinsic protocol
+    limit — it is an existence proof that the batched path sustains
+    config-2's election-only workload, normalized per wall-second.
+    Expected value from the knobs here (crash_prob=0.5, crash_epoch=32):
+    each epoch the leader crashes w.p. ~0.5 and a ~15-tick re-election
+    follows, so roughly one election per group per ~2 epochs =
+    ~1 / 64 ticks; at G groups and measured ticks/sec the schedule
+    supports ~G x ticks_per_sec / 64 elections/sec, and the observed
+    rate should sit near that ceiling (the bench JSON carries the raw
+    election count so under-sampling is visible)."""
     cfg = RaftConfig(seed=44, cmds_per_tick=0, crash_prob=0.5,
                      crash_epoch=32)
     st = sim.init(cfg, n_groups=n_groups)
@@ -144,16 +161,23 @@ def main():
         r_groups, r_ticks = 1_000, 200
     else:
         # The headline runs at the true config-5 shape: 100K groups.
+        # (History: a TPU kernel fault at 100K groups blocked this shape
+        # in round 2; it stopped reproducing in round 3 with no hot-path
+        # change and has not been seen since — if a 100K run ever dies
+        # in the runtime again, that regression has a precedent.)
         groups, ticks = args.groups or 100_000, 600
         e_groups, e_ticks = 50_000, 600      # config-4 shape
-        r_groups, r_ticks = 10_000, 600      # config-2 shape
+        # Config-2: 2400 ticks so the timed region is seconds, not
+        # sub-second (the rate is schedule-bound; see the fn docstring).
+        r_groups, r_ticks = 10_000, 2400
 
     log(f"throughput (config-5 shape, {groups} x 5-node groups):")
     rps, rounds, elapsed, ticks = bench_throughput(groups, ticks)
     log("election latency (config-4 shape):")
-    p50, p99, n_elections = bench_elections(e_groups, e_ticks)
+    p50, p99, n_elections, censored, max_lat = bench_elections(
+        e_groups, e_ticks)
     log("election rounds (config-2 shape):")
-    eps, rounds_elections = bench_election_rounds(r_groups, r_ticks)
+    eps, n_c2_elections = bench_election_rounds(r_groups, r_ticks)
 
     print(json.dumps({
         "metric": "consensus_rounds_per_sec_per_chip",
@@ -165,8 +189,12 @@ def main():
         "wall_s": round(elapsed, 3),
         "p50_election_latency_ticks": p50,
         "p99_election_latency_ticks": p99,
+        "p99_censored": censored,
+        "max_election_latency_ticks": max_lat,
         "elections_observed": n_elections,
         "elections_per_sec": round(eps, 1),
+        "config2_elections_observed": n_c2_elections,
+        "config2_note": "schedule-bound rate; see bench_election_rounds",
         "device": f"{dev.platform}:{dev.device_kind}",
     }))
 

@@ -12,6 +12,13 @@ import dataclasses
 
 _U32 = 0xFFFFFFFF
 
+# Log-entry payload encoding. Client payloads are 30-bit hashes; a set
+# CONFIG_FLAG bit marks a membership-change entry whose low k bits are
+# the new voter bitmask (single-server delta from the previous config).
+# Both backends share these constants so the encodings cannot drift.
+CONFIG_FLAG = 1 << 30
+PAYLOAD_MASK = CONFIG_FLAG - 1
+
 
 def _prob_to_u32(p: float) -> int:
     """Map a probability to a uint32 threshold: event iff hash < threshold.
@@ -47,6 +54,16 @@ class RaftConfig:
     partition_prob: float = 0.0  # per-group per-epoch partition probability
     partition_epoch: int = 64    # ticks per partition epoch
 
+    # Membership-change schedule (DESIGN.md §2b). Off by default. At the
+    # first tick of each reconfig epoch, w.p. reconfig_prob the leader
+    # proposes toggling one hash-chosen node's membership — subject to
+    # the single-server gating rules and to the resulting config keeping
+    # at least min_voters voters (0 = k//2 + 1, keeping quorums live
+    # under the crash schedule).
+    reconfig_prob: float = 0.0
+    reconfig_epoch: int = 64
+    min_voters: int = 0
+
     def __post_init__(self):
         assert self.k >= 1
         assert self.election_range >= 1
@@ -64,7 +81,23 @@ class RaftConfig:
 
     @property
     def majority(self) -> int:
+        """Majority of the FULL k-node set — the initial config. Live
+        quorum decisions use the majority of the active voter mask
+        (`voter_majority`), which equals this until a membership change
+        commits."""
         return self.k // 2 + 1
+
+    @property
+    def full_mask(self) -> int:
+        return (1 << self.k) - 1
+
+    @property
+    def effective_min_voters(self) -> int:
+        return self.min_voters if self.min_voters > 0 else self.k // 2 + 1
+
+    @property
+    def reconfig_u32(self) -> int:
+        return _prob_to_u32(self.reconfig_prob)
 
     @property
     def drop_u32(self) -> int:

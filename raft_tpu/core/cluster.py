@@ -28,6 +28,7 @@ class NodeView:
     digest: int
     snap_index: int
     snap_term: int
+    snap_voters: int
     alive: bool
 
 
@@ -100,6 +101,8 @@ class Cluster:
     def tick(self):
         t = self.tick_count
         alive_now = self.alive(t)
+        for n in self.nodes:
+            n.now = t   # client-API clock (ReadIndex ack timestamps)
         for i, n in enumerate(self.nodes):
             if alive_now[i] and not self.alive_prev[i]:
                 n.restart()
@@ -127,6 +130,85 @@ class Cluster:
         for _ in range(ticks):
             self.tick()
 
+    # ------------------------------------------------------------ client API
+
+    def propose(self, payload: int):
+        """Route a client write to the current leader. Returns a
+        (index, payload) ticket or None (no leader / window full —
+        retry). Committed iff `is_committed(ticket)` ever holds; a
+        ticket can also be lost (leader deposed before replication), in
+        which case it never commits and the client re-proposes."""
+        lead = self.leader()
+        if lead is None:
+            return None
+        idx = self.nodes[lead].propose(payload)
+        if idx is None:
+            return None
+        return (idx, payload)
+
+    def is_committed(self, ticket) -> bool:
+        """True iff the proposed (index, payload) has been applied by
+        some node — the commit-identity map is the authority."""
+        idx, payload = ticket
+        return self._committed.get(idx) == payload
+
+    def propose_reconfig(self, new_mask: int):
+        """Route a single-server membership change to the current leader.
+        Returns the (index, payload) ticket or None."""
+        lead = self.leader()
+        if lead is None:
+            return None
+        idx = self.nodes[lead].propose_config(new_mask)
+        if idx is None:
+            return None
+        return (idx, self.nodes[lead].payload_at(idx))
+
+    def read_begin(self):
+        """Begin a linearizable read on the current leader. Returns
+        (leader_id, rid) or None if no leader."""
+        lead = self.leader()
+        if lead is None:
+            return None
+        rid = self.nodes[lead].read_begin()
+        if rid is None:
+            return None
+        return (lead, rid)
+
+    def read_poll(self, handle):
+        """Poll a read begun with `read_begin`: Node.READ_ABORTED,
+        Node.READ_PENDING, or (read_index, served_index, digest)."""
+        lead, rid = handle
+        n = self.nodes[lead]
+        if not self.alive_prev[lead]:
+            return Node.READ_ABORTED
+        return n.read_poll(rid)
+
+    def read(self, max_ticks: int = 200):
+        """Convenience: begin a read (retrying while leaderless) and tick
+        until it completes. Returns (read_index, served_index, digest)
+        or None if no read completed within `max_ticks`."""
+        handle = None
+        for _ in range(max_ticks):
+            if handle is None:
+                handle = self.read_begin()
+            if handle is not None:
+                r = self.read_poll(handle)
+                if r == Node.READ_ABORTED:
+                    handle = None
+                elif r != Node.READ_PENDING:
+                    return r
+            self.tick()
+        return None
+
+    def expected_digest(self, through_index: int) -> int:
+        """Replay the commit-identity map's hash chain through
+        `through_index` — the value any node's digest must hold after
+        applying exactly that prefix (read-your-writes checker)."""
+        d = 0
+        for i in range(1, through_index + 1):
+            d = rng.digest_update(d, i, self._committed[i])
+        return d
+
     # ------------------------------------------------------------- observers
 
     def leader(self) -> Optional[int]:
@@ -143,5 +225,5 @@ class Cluster:
                          leader_id=n.leader_id, last_index=n.last_index,
                          commit=n.commit, applied=n.applied, digest=n.digest,
                          snap_index=n.snap_index, snap_term=n.snap_term,
-                         alive=self.alive_prev[i])
+                         snap_voters=n.snap_voters, alive=self.alive_prev[i])
                 for i, n in enumerate(self.nodes)]

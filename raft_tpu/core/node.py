@@ -16,12 +16,17 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional
 
-from raft_tpu.config import RaftConfig
+from raft_tpu.config import CONFIG_FLAG, RaftConfig
 from raft_tpu.core import rpc
 from raft_tpu.utils import rng
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 NO_VOTE = -1
+
+
+def majority_of(voters: int) -> int:
+    """Majority size of a voter bitmask."""
+    return voters.bit_count() // 2 + 1
 
 
 class Node:
@@ -40,6 +45,7 @@ class Node:
         self.snap_index = 0
         self.snap_term = 0
         self.snap_digest = 0
+        self.snap_voters = cfg.full_mask  # voter mask as of the snapshot prefix
         self.rng_draws = 0           # monotone deadline-draw counter
 
         # Volatile state (reset on restart).
@@ -54,6 +60,16 @@ class Node:
         self.election_elapsed = 0
         self.heartbeat_elapsed = 0
         self.deadline = 0
+        # Client-facing state (volatile, leader-only): `now` is the
+        # current tick (set by the harness before phases), `ack_time[p]`
+        # the last tick a current-term AppendEntries response arrived
+        # from peer p (any such response proves p's deference at the
+        # time it was sent), `pending_reads` the ReadIndex protocol
+        # state: rid -> (read_index, registration tick).
+        self.now = 0
+        self.ack_time = [-1] * cfg.k
+        self.pending_reads: dict = {}
+        self._next_read_id = 0
         self._reset_election_timer()
 
     # ------------------------------------------------------------- log helpers
@@ -74,6 +90,36 @@ class Node:
 
     def last_log_term(self) -> int:
         return self.term_at(self.last_index)
+
+    # ----------------------------------------------------- membership config
+
+    def current_config(self):
+        """(voters_mask, cfg_index): the latest membership-change entry in
+        the log — committed or not, per the dissertation's §4.1 rule — or
+        the snapshot's config if the window holds none. Derived, never
+        stored: truncation of a config entry reverts the config with no
+        bookkeeping."""
+        for j in range(len(self.log) - 1, -1, -1):
+            _, payload = self.log[j]
+            if payload & CONFIG_FLAG:
+                return payload & self.cfg.full_mask, self.snap_index + 1 + j
+        return self.snap_voters, self.snap_index
+
+    def committed_config(self) -> int:
+        """Voter mask implied by the committed prefix (<= commit) — what
+        compaction folds into `snap_voters`, and the authority for the
+        'removed leader steps down' rule."""
+        hi = min(self.commit, self.last_index) - self.snap_index
+        for j in range(hi - 1, -1, -1):
+            _, payload = self.log[j]
+            if payload & CONFIG_FLAG:
+                return payload & self.cfg.full_mask
+        return self.snap_voters
+
+    def is_voter(self, node_id: Optional[int] = None) -> bool:
+        i = self.id if node_id is None else node_id
+        voters, _ = self.current_config()
+        return bool((voters >> i) & 1)
 
     def _window_has_room(self, n: int = 1) -> bool:
         return self.last_index + n - self.snap_index <= self.cfg.log_cap
@@ -100,12 +146,20 @@ class Node:
         self.voted_for = NO_VOTE
         self.leader_id = NO_VOTE
         self.votes = [False] * self.cfg.k
+        self._drop_client_state()
+
+    def _drop_client_state(self):
+        """Leadership (or the term it was held under) is gone: pending
+        reads abort, deference evidence is stale."""
+        self.ack_time = [-1] * self.cfg.k
+        self.pending_reads = {}
 
     def _become_leader(self):
         self.role = LEADER
         self.leader_id = self.id
         self.next_index = [self.last_index + 1] * self.cfg.k
         self.match_index = [0] * self.cfg.k
+        self._drop_client_state()
         # Fire the initial heartbeat in phase T of this same tick.
         self.heartbeat_elapsed = self.cfg.heartbeat_every
         # Paxos-style takeover (DESIGN.md §2a): re-propose the TOP entry —
@@ -124,6 +178,15 @@ class Node:
             pos = self.last_index - self.snap_index - 1
             self.log[pos] = (self.term, self.log[pos][1])
 
+    def _vote_quorum(self) -> bool:
+        """Votes granted by members of the CURRENT config reach its
+        majority (a vote from a non-voter — e.g. a peer the latest config
+        entry removed — is received but never counted)."""
+        voters, _ = self.current_config()
+        granted = sum(1 for p in range(self.cfg.k)
+                      if self.votes[p] and (voters >> p) & 1)
+        return granted >= majority_of(voters)
+
     def _start_election(self):
         self.term += 1
         self.role = CANDIDATE
@@ -131,7 +194,7 @@ class Node:
         self.leader_id = NO_VOTE
         self.votes = [i == self.id for i in range(self.cfg.k)]
         self._reset_election_timer()
-        if self.cfg.majority == 1:
+        if self._vote_quorum():   # single-voter config: instant leader
             self._become_leader()
             return
         for p in range(self.cfg.k):
@@ -152,6 +215,7 @@ class Node:
         self.next_index = [1] * self.cfg.k
         self.match_index = [0] * self.cfg.k
         self.heartbeat_elapsed = 0
+        self._drop_client_state()
         self._reset_election_timer()
 
     # ---------------------------------------------------------------- phase D
@@ -193,7 +257,7 @@ class Node:
         if self.role != CANDIDATE or m.term != self.term or not m.granted:
             return
         self.votes[m.src] = True
-        if sum(self.votes) >= self.cfg.majority:
+        if self._vote_quorum():
             self._become_leader()
 
     def _accept_leader(self, m):
@@ -271,6 +335,9 @@ class Node:
             return
         if self.role != LEADER or m.term != self.term:
             return
+        # Any current-term response (success or not) proves the sender
+        # deferred to this leader when it replied — ReadIndex evidence.
+        self.ack_time[m.src] = self.now
         if m.success:
             self.match_index[m.src] = max(self.match_index[m.src], m.match)
             self.next_index[m.src] = self.match_index[m.src] + 1
@@ -301,6 +368,7 @@ class Node:
         self.snap_index = m.snap_index
         self.snap_term = m.snap_term
         self.snap_digest = m.snap_digest
+        self.snap_voters = m.snap_voters
         self.commit = m.snap_index
         self.applied = m.snap_index
         self.digest = m.snap_digest
@@ -313,8 +381,86 @@ class Node:
             return
         if self.role != LEADER or m.term != self.term:
             return
+        self.ack_time[m.src] = self.now
         self.match_index[m.src] = max(self.match_index[m.src], m.match)
         self.next_index[m.src] = self.match_index[m.src] + 1
+
+    # ------------------------------------------------------------- client API
+
+    def propose(self, payload: int):
+        """Client write: append `payload` under the current term.
+
+        Returns the assigned absolute index, or None if this node is not
+        the leader or the log window is full (flow control — retry after
+        compaction frees space). The entry is durably committed once some
+        node applies (index, payload); the ticket for that check is the
+        (index, payload) pair — terms are ballot numbers and may be
+        rewritten in place by a takeover re-proposal (DESIGN.md §2a).
+        """
+        if self.role != LEADER:
+            return None
+        if not self._append(self.term, payload):
+            return None
+        return self.last_index
+
+    def read_begin(self):
+        """Begin a linearizable ReadIndex read (Raft dissertation §6.4).
+
+        Records the current commit index and the registration tick;
+        returns a read id, or None if not leader. The read completes
+        once (a) a majority of peers have sent this leader a current-term
+        response at a tick >= registration + 2 — in the lockstep tick
+        model a response received at tick t was emitted at t-1 reacting
+        to authority this leader held at t-2, so t >= reg + 2 proves the
+        peer still deferred to this leader strictly after the read was
+        registered (no newer leader could have been elected before reg
+        without this majority having refused us) — and (b) the state
+        machine has applied through the recorded read index.
+
+        A freshly elected leader must not serve reads yet: its commit
+        index can lag entries committed by prior leaders (dissertation
+        §6.4 step 1). Serving is safe once (a) the entry at `commit`
+        carries the current term — the takeover re-proposal (DESIGN.md
+        §2a) guarantees a current-term entry at the takeover
+        `last_index`, which is >= every previously committed index, so
+        committing it pulls `commit` past all prior commits — or (b)
+        `commit == last_index`, in which case Leader Completeness bounds
+        every committed entry by `last_index` directly. Until then:
+        return None, client retries.
+        """
+        if self.role != LEADER:
+            return None
+        if not (self.commit == self.last_index
+                or self.term_at(self.commit) == self.term):
+            return None
+        rid = self._next_read_id
+        self._next_read_id += 1
+        self.pending_reads[rid] = (self.commit, self.now)
+        return rid
+
+    READ_PENDING = "pending"
+    READ_ABORTED = "aborted"
+
+    def read_poll(self, rid: int):
+        """Poll a pending read: READ_ABORTED (leadership lost — retry on
+        the new leader), READ_PENDING, or (read_index, served_index,
+        digest) once the quorum round-trip confirmed leadership and the
+        state machine caught up. The digest is the machine state after
+        applying exactly `served_index` entries (served_index >=
+        read_index), which includes every write committed before the
+        read began — serving a later applied state is still
+        linearizable because that state is current at completion."""
+        if rid not in self.pending_reads:
+            return self.READ_ABORTED
+        read_index, reg_tick = self.pending_reads[rid]
+        acks = sum(1 for p in range(self.cfg.k)
+                   if p != self.id and self.ack_time[p] >= reg_tick + 2)
+        if acks + 1 < self.cfg.majority:
+            return self.READ_PENDING
+        if self.applied < read_index:
+            return self.READ_PENDING
+        del self.pending_reads[rid]
+        return (read_index, self.applied, self.digest)
 
     # ---------------------------------------------------------------- phase T
 
@@ -326,7 +472,10 @@ class Node:
                 self._broadcast_append()
         else:
             self.election_elapsed += 1
-            if self.election_elapsed >= self.deadline:
+            # Non-voters (servers the latest config removed) never start
+            # elections — they keep replicating as learners and keep
+            # granting votes, but cannot disrupt the voters' regime.
+            if self.election_elapsed >= self.deadline and self.is_voter():
                 self._start_election()
 
     def _broadcast_append(self):
@@ -337,7 +486,8 @@ class Node:
                 self.transport.send(rpc.InstallSnapshotReq(
                     rpc.IS_REQ, self.id, p, term=self.term,
                     snap_index=self.snap_index, snap_term=self.snap_term,
-                    snap_digest=self.snap_digest))
+                    snap_digest=self.snap_digest,
+                    snap_voters=self.snap_voters))
             else:
                 prev = self.next_index[p] - 1
                 n = min(self.cfg.max_entries_per_msg, self.last_index - prev)
@@ -350,9 +500,57 @@ class Node:
 
     # ---------------------------------------------------------------- phase C
 
+    def _reconfig_gate(self, new_mask: int):
+        """Single-server change preconditions (dissertation §4.1 + the
+        2015 single-server bugfix): the previous config entry must be
+        committed, and this leader must have committed an entry of its
+        own term. Returns the (voters, cfg_index) pair if clear."""
+        voters, cfg_index = self.current_config()
+        if cfg_index > self.commit:
+            return None
+        if self.term_at(self.commit) != self.term:
+            return None
+        if (new_mask ^ voters).bit_count() != 1:
+            return None   # not a single-server delta
+        return voters, cfg_index
+
+    def _maybe_propose_reconfig(self):
+        """The deterministic membership-change schedule (DESIGN.md §2b):
+        at the first tick of each reconfig epoch, w.p. reconfig_prob,
+        toggle one hash-chosen node — if the gate clears and the result
+        keeps at least min_voters voters."""
+        cfg = self.cfg
+        if cfg.reconfig_u32 == 0 or self.now % cfg.reconfig_epoch != 0:
+            return
+        epoch = self.now // cfg.reconfig_epoch
+        if not rng.reconfig_fires(cfg.seed, self.g, epoch, cfg.reconfig_u32):
+            return
+        target = rng.reconfig_target(cfg.seed, self.g, epoch, cfg.k)
+        voters, _ = self.current_config()
+        new_mask = voters ^ (1 << target)
+        if new_mask.bit_count() < cfg.effective_min_voters:
+            return
+        if self._reconfig_gate(new_mask) is None:
+            return
+        self._append(self.term, CONFIG_FLAG | new_mask)
+
+    def propose_config(self, new_mask: int):
+        """Client API: propose a single-server membership change. Returns
+        the assigned index or None (not leader / gate closed / window
+        full). `new_mask` must differ from the current config by exactly
+        one member."""
+        if self.role != LEADER:
+            return None
+        if self._reconfig_gate(new_mask) is None:
+            return None
+        if not self._append(self.term, CONFIG_FLAG | new_mask):
+            return None
+        return self.last_index
+
     def phase_c(self):
         if self.role != LEADER:
             return
+        self._maybe_propose_reconfig()
         for _ in range(self.cfg.cmds_per_tick):
             payload = rng.client_payload(
                 self.cfg.seed, self.g, self.term, self.last_index + 1)
@@ -363,14 +561,26 @@ class Node:
 
     def phase_a(self):
         if self.role == LEADER:
-            matches = sorted(
-                (self.match_index[p] for p in range(self.cfg.k) if p != self.id),
+            voters, _ = self.current_config()
+            # Replication tally over CURRENT voters only; the leader
+            # counts itself (at last_index) iff it is still a voter.
+            vals = sorted(
+                (self.last_index if p == self.id else self.match_index[p]
+                 for p in range(self.cfg.k) if (voters >> p) & 1),
                 reverse=True)
-            matches.insert(0, self.last_index)  # self always "matches" itself
-            n = matches[self.cfg.majority - 1]
-            # §5.4.2: only entries of the current term commit by counting.
-            if n > self.commit and self.term_at(n) == self.term:
-                self.commit = n
+            if vals:
+                n = vals[majority_of(voters) - 1]
+                # §5.4.2: only entries of the current term commit by counting.
+                if n > self.commit and self.term_at(n) == self.term:
+                    self.commit = n
+            # A removed leader steps down once its removal is committed
+            # (latest config entry committed and it is not in it).
+            voters, cfg_index = self.current_config()
+            if cfg_index <= self.commit and not (voters >> self.id) & 1:
+                self.role = FOLLOWER
+                self.leader_id = NO_VOTE
+                self.votes = [False] * self.cfg.k
+                self._drop_client_state()
         while self.applied < self.commit:
             self.applied += 1
             t, p = self.log[self.applied - self.snap_index - 1]
@@ -378,6 +588,7 @@ class Node:
             if self.on_apply is not None:
                 self.on_apply(self.id, self.applied, t, p)
         if self.commit - self.snap_index >= self.cfg.compact_every:
+            self.snap_voters = self.committed_config()
             self.snap_term = self.term_at(self.commit)
             self.log = self.log[self.commit - self.snap_index:]
             self.snap_index = self.commit

@@ -59,6 +59,7 @@ class InstallSnapshotReq(Msg):
     snap_index: int = 0
     snap_term: int = 0
     snap_digest: int = 0
+    snap_voters: int = 0   # voter bitmask as of the snapshot prefix
 
 
 @dataclasses.dataclass(frozen=True)

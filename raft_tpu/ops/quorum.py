@@ -19,12 +19,53 @@ Semantics are pinned to the CPU oracle, `core/node.py`:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
 def vote_count(votes):
     """Number of granted votes. ``votes``: bool[K] (or any trailing shape)."""
     return jnp.sum(votes.astype(jnp.int32), axis=-1)
+
+
+def popcount(mask):
+    """Set bits of an i32/u32 bitmask."""
+    return jax.lax.population_count(
+        jnp.asarray(mask).astype(jnp.uint32)).astype(jnp.int32)
+
+
+def voter_majority(voters):
+    """Majority size of a voter bitmask (node.py `majority_of`)."""
+    return popcount(voters) // 2 + 1
+
+
+def voter_bits(voters, k: int):
+    """bool[K]: lane p is a member of the voter bitmask."""
+    return ((voters >> jnp.arange(k, dtype=jnp.int32)) & 1) == 1
+
+
+def vote_won(votes, voters, k: int):
+    """`Node._vote_quorum`: granted votes from CURRENT-config voters
+    reach that config's majority. ``votes``: bool[K]; ``voters``: i32."""
+    granted = jnp.sum((votes & voter_bits(voters, k)).astype(jnp.int32), -1)
+    return granted >= voter_majority(voters)
+
+
+def commit_candidate_voters(match_index, last_index, node_id, voters, k: int):
+    """Voters-aware commit tally (node.py phase_a): the majority(voters)-th
+    largest replication index among voters, where the leader contributes
+    `last_index` for itself iff it is a voter. Returns -1 when no voters
+    exist (callers mask). Matches the CPU sort exactly: non-voters are
+    forced to -1 (real indices are >= 0) and the k-lane descending sort's
+    element at majority-1 is selected by one-hot."""
+    lanes = jnp.arange(k, dtype=jnp.int32)
+    own = lanes == node_id
+    vals = jnp.where(voter_bits(voters, k),
+                     jnp.where(own, last_index, match_index),
+                     jnp.int32(-1))
+    desc = jnp.sort(vals)[::-1]
+    pick = voter_majority(voters) - 1
+    return jnp.sum(jnp.where(lanes == pick, desc, 0), -1)
 
 
 def commit_candidate(match_index, last_index, node_id, k: int, majority: int):

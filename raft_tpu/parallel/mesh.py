@@ -66,6 +66,7 @@ class GlobalMetrics(NamedTuple):
     rounds: jnp.ndarray      # i32 — total committed entries, psum over mesh
     elections: jnp.ndarray   # i32 — completed leader acquisitions, psum
     hist: jnp.ndarray        # i32[H] — election-latency histogram, psum
+    max_latency: jnp.ndarray  # i32 — longest completed streak, pmax
 
 
 def run_sharded(cfg: RaftConfig, st: State, n_ticks: int, mesh: Mesh,
@@ -88,6 +89,7 @@ def run_sharded(cfg: RaftConfig, st: State, n_ticks: int, mesh: Mesh,
             rounds=jax.lax.psum(jnp.sum(m.committed), AXIS),
             elections=jax.lax.psum(m.elections, AXIS),
             hist=jax.lax.psum(m.hist, AXIS),
+            max_latency=jax.lax.pmax(m.max_latency, AXIS),
         )
 
     f = jax.shard_map(local, mesh=mesh, in_specs=(P(AXIS),),

@@ -1,0 +1,91 @@
+"""Host-side checkpoint of the batched simulator (SURVEY.md §5,
+elastic recovery / checkpoint row).
+
+A `State` is a pytree of dense arrays and the simulation is a pure
+function of `(cfg, state, t)`, so a checkpoint is just the flattened
+pytree plus the absolute tick counter: save both, reload in any process
+(same cfg), continue from `t` — bit-identical to a run that never
+stopped (`tests/test_checkpoint.py`). Metrics ride along optionally so a
+resumed benchmark keeps its histograms.
+
+Format: a single `.npz` with dot-separated field paths as keys and two
+metadata scalars (`__tick__`, `__version__`). Everything is numpy on the
+way out, `jnp` on the way in — no pickling, no host objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.sim.run import Metrics
+from raft_tpu.sim.state import Mailbox, PerNode, State
+
+_VERSION = 1
+
+
+def _flatten(prefix: str, obj, out: dict):
+    if hasattr(obj, "_fields"):   # NamedTuple node
+        for f in obj._fields:
+            _flatten(f"{prefix}{f}.", getattr(obj, f), out)
+    else:
+        out[prefix[:-1]] = np.asarray(obj)
+
+
+def save(path, st: State, t: int, metrics: Optional[Metrics] = None,
+         cfg: Optional[RaftConfig] = None) -> None:
+    """Write `st` (+ optional metrics) and the absolute tick `t` to `path`.
+
+    Pass `cfg` to embed the semantic config: `load` then refuses to resume
+    under a different one (same shapes, different seed/fault knobs would
+    silently continue the wrong universe otherwise)."""
+    flat: dict = {"__version__": np.int64(_VERSION), "__tick__": np.int64(t)}
+    if cfg is not None:
+        flat["__cfg__"] = np.bytes_(
+            json.dumps(dataclasses.asdict(cfg), sort_keys=True))
+    _flatten("state.", st, flat)
+    if metrics is not None:
+        _flatten("metrics.", metrics, flat)
+    np.savez(path, **flat)
+
+
+def _load_nt(z, prefix: str, cls):
+    return cls(**{f: jnp.asarray(z[f"{prefix}{f}"]) for f in cls._fields})
+
+
+def load(path, cfg: Optional[RaftConfig] = None
+         ) -> Tuple[State, int, Optional[Metrics]]:
+    """Read (state, tick, metrics-or-None) from `path`.
+
+    If `cfg` is given and the checkpoint embeds one, they must match
+    exactly — resuming a deterministic universe under different semantic
+    knobs is always a bug."""
+    with np.load(path) as z:
+        version = int(z["__version__"])
+        if version != _VERSION:
+            raise ValueError(f"unsupported checkpoint version {version}")
+        if cfg is not None and "__cfg__" in z.files:
+            saved = json.loads(bytes(z["__cfg__"]).decode())
+            want = json.loads(json.dumps(dataclasses.asdict(cfg)))
+            if saved != want:
+                diff = {k: (saved.get(k), want.get(k))
+                        for k in set(saved) | set(want)
+                        if saved.get(k) != want.get(k)}
+                raise ValueError(f"checkpoint cfg mismatch: {diff}")
+        t = int(z["__tick__"])
+        st = State(
+            nodes=_load_nt(z, "state.nodes.", PerNode),
+            mailbox=_load_nt(z, "state.mailbox.", Mailbox),
+            alive_prev=jnp.asarray(z["state.alive_prev"]),
+            group_id=jnp.asarray(z["state.group_id"]),
+        )
+        metrics = None
+        if "metrics.committed" in z.files:
+            metrics = Metrics(**{f: jnp.asarray(z[f"metrics.{f}"])
+                                 for f in Metrics._fields})
+    return st, t, metrics

@@ -9,10 +9,14 @@ Metrics:
   index — total entries durably committed by the group ("consensus
   rounds"; a restart rewinds a node's local commit knowledge, never the
   group's achievement, hence the running max).
-- election latency: per group, the length of each leaderless streak
-  (ticks with no alive leader), recorded into a bounded histogram
-  `[0..H)` when a leader (re)appears; bucket H-1 absorbs the tail.
-  p50/p99 are computed host-side from the histogram (`latency_quantile`).
+- election latency (leaderless-interval, DESIGN.md §6): per group, the
+  length of each leaderless streak — consecutive ticks with no alive
+  leader — recorded when a leader (re)appears. Streaks land in a bounded
+  histogram `[0..H)`; bucket H-1 absorbs anything longer, and
+  `max_latency` tracks the exact longest completed streak so censoring
+  is always detectable: `latency_censored(hist, q)` says whether the
+  q-quantile hit the absorbing bucket. p50/p99 are computed host-side
+  from the histogram (`latency_quantile`).
 """
 
 from __future__ import annotations
@@ -29,7 +33,7 @@ from raft_tpu.core.node import LEADER
 from raft_tpu.sim.state import I32, State
 from raft_tpu.sim.step import tick
 
-HIST_SIZE = 128
+HIST_SIZE = 512
 
 
 class Metrics(NamedTuple):
@@ -37,6 +41,7 @@ class Metrics(NamedTuple):
     leaderless: jnp.ndarray  # i32[G] — current leaderless streak, in ticks
     elections: jnp.ndarray   # i32 — completed leader-acquisition events
     hist: jnp.ndarray        # i32[H] — election-latency histogram
+    max_latency: jnp.ndarray  # i32 — exact longest completed streak
 
 
 def metrics_init(n_groups: int, hist_size: int = HIST_SIZE) -> Metrics:
@@ -45,6 +50,7 @@ def metrics_init(n_groups: int, hist_size: int = HIST_SIZE) -> Metrics:
         leaderless=jnp.zeros(n_groups, I32),
         elections=jnp.zeros((), I32),
         hist=jnp.zeros(hist_size, I32),
+        max_latency=jnp.zeros((), I32),
     )
 
 
@@ -61,6 +67,8 @@ def metrics_update(m: Metrics, st: State) -> Metrics:
         leaderless=jnp.where(has_leader, 0, m.leaderless + 1),
         elections=m.elections + jnp.sum(done.astype(I32)),
         hist=m.hist.at[bucket].add(done.astype(I32)),
+        max_latency=jnp.maximum(
+            m.max_latency, jnp.max(jnp.where(done, m.leaderless, 0))),
     )
 
 
@@ -86,7 +94,8 @@ def run(cfg: RaftConfig, st: State, n_ticks: int, t0=0,
 
 
 TRACE_FIELDS = ("term", "role", "voted_for", "leader_id", "last_index",
-                "commit", "applied", "digest", "snap_index", "snap_term")
+                "commit", "applied", "digest", "snap_index", "snap_term",
+                "snap_voters")
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2))
@@ -121,3 +130,11 @@ def latency_quantile(hist, q: float) -> int:
         return 0
     cum = np.cumsum(h)
     return int(np.searchsorted(cum, q * total, side="left"))
+
+
+def latency_censored(hist, q: float) -> bool:
+    """True iff the q-quantile landed in the absorbing top bucket — i.e.
+    the reported quantile is a floor, not a measurement. Benches must
+    surface this flag next to any quantile they print."""
+    h = np.asarray(hist)
+    return h.sum() > 0 and latency_quantile(hist, q) >= h.shape[0] - 1

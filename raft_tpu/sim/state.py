@@ -45,6 +45,7 @@ class PerNode(NamedTuple):
     snap_index: jnp.ndarray   # i32
     snap_term: jnp.ndarray    # i32
     snap_digest: jnp.ndarray  # u32
+    snap_voters: jnp.ndarray  # i32 — voter bitmask as of the snapshot prefix
     rng_draws: jnp.ndarray    # i32 — monotone deadline-draw counter
     last_index: jnp.ndarray   # i32 (CPU: derived from len(log); explicit here)
     log_term: jnp.ndarray     # i32[L], ring slot (i-1) % L
@@ -100,6 +101,7 @@ class Mailbox(NamedTuple):
     is_req_snap_index: jnp.ndarray   # i32
     is_req_snap_term: jnp.ndarray    # i32
     is_req_snap_digest: jnp.ndarray  # u32
+    is_req_snap_voters: jnp.ndarray  # i32
 
     is_resp_present: jnp.ndarray  # bool
     is_resp_term: jnp.ndarray     # i32
@@ -135,6 +137,7 @@ def empty_mailbox(lead_shape: tuple, e: int) -> Mailbox:
         ae_resp_match=z(I32),
         is_req_present=z(BOOL), is_req_term=z(I32), is_req_snap_index=z(I32),
         is_req_snap_term=z(I32), is_req_snap_digest=z(U32),
+        is_req_snap_voters=z(I32),
         is_resp_present=z(BOOL), is_resp_term=z(I32), is_resp_match=z(I32),
     )
 
@@ -159,6 +162,7 @@ def init(cfg: RaftConfig, n_groups: int | None = None) -> State:
         term=z(I32),
         voted_for=jnp.full((g, k), NO_VOTE, I32),
         snap_index=z(I32), snap_term=z(I32), snap_digest=z(U32),
+        snap_voters=jnp.full((g, k), cfg.full_mask, I32),
         rng_draws=jnp.ones((g, k), I32),
         last_index=z(I32),
         log_term=z(I32, cap), log_payload=z(I32, cap),

@@ -26,7 +26,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from raft_tpu.config import RaftConfig
+from raft_tpu.config import CONFIG_FLAG, RaftConfig
 from raft_tpu.core.node import CANDIDATE, FOLLOWER, LEADER, NO_VOTE
 from raft_tpu.ops import quorum
 from raft_tpu.sim.state import (BOOL, I32, Mailbox, PerNode, State,
@@ -77,6 +77,42 @@ def _last_log_term(cfg, ns: PerNode):
 def _put(arr, p: int, cond, val):
     """Masked write of outbox slot p (p is a static unroll index)."""
     return arr.at[p].set(jnp.where(cond, val, arr[p]))
+
+
+# ------------------------------------------------------- membership config
+
+
+def _abs_index(cfg, ns: PerNode):
+    """i32[L]: the absolute index each live-window ring slot holds
+    (>= snap_index + 1 by construction; slots beyond last_index are
+    stale and must be masked by the caller)."""
+    return ns.snap_index + 1 + (
+        jnp.arange(cfg.log_cap, dtype=I32) - ns.snap_index) % cfg.log_cap
+
+
+def _config_scan(cfg, ns: PerNode, through):
+    """(voters, cfg_index): the config entry with the highest absolute
+    index <= `through` in the live window, else the snapshot's config —
+    `Node.current_config` / `Node.committed_config` (derived, never
+    stored: truncation reverts membership with no bookkeeping)."""
+    absidx = _abs_index(cfg, ns)
+    is_cfg = (((ns.log_payload & CONFIG_FLAG) != 0)
+              & (absidx <= jnp.minimum(ns.last_index, through)))
+    best = jnp.max(jnp.where(is_cfg, absidx, 0), -1)   # 0 == none (abs >= 1)
+    found = best > 0
+    mask_at = jnp.sum(
+        jnp.where(is_cfg & (absidx == best[..., None]), ns.log_payload, 0),
+        -1) & cfg.full_mask
+    return (jnp.where(found, mask_at, ns.snap_voters),
+            jnp.where(found, best, ns.snap_index))
+
+
+def _current_config(cfg, ns: PerNode):
+    return _config_scan(cfg, ns, jnp.int32(0x7FFFFFFF))
+
+
+def _committed_voters(cfg, ns: PerNode, commit):
+    return _config_scan(cfg, ns, commit)[0]
 
 
 # -------------------------------------------------------------- transitions
@@ -164,7 +200,8 @@ def _on_rv_resp(cfg, ns, out, g, i, src: int, ib: Mailbox):
             & (m_term == ns.term) & m_granted)
     votes = ns.votes.at[src].set(ns.votes[src] | cont)
     ns = ns._replace(votes=votes)
-    won = cont & (quorum.vote_count(votes) >= cfg.majority)
+    voters, _ = _current_config(cfg, ns)
+    won = cont & quorum.vote_won(votes, voters, cfg.k)
     return _become_leader(cfg, ns, i, won), out
 
 
@@ -280,6 +317,7 @@ def _on_is_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
     m_si = ib.is_req_snap_index[src]
     m_st = ib.is_req_snap_term[src]
     m_sd = ib.is_req_snap_digest[src]
+    m_sv = ib.is_req_snap_voters[src]
     ns = _step_down(ns, m_term, present & (m_term > ns.term))
     stale = present & (m_term < ns.term)
     ok = present & ~stale
@@ -296,6 +334,7 @@ def _on_is_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
         snap_index=jnp.where(inst, m_si, ns.snap_index),
         snap_term=jnp.where(inst, m_st, ns.snap_term),
         snap_digest=jnp.where(inst, m_sd, ns.snap_digest),
+        snap_voters=jnp.where(inst, m_sv, ns.snap_voters),
         commit=jnp.where(inst, m_si, ns.commit),
         applied=jnp.where(inst, m_si, ns.applied),
         digest=jnp.where(inst, m_sd, ns.digest),
@@ -354,6 +393,8 @@ def _phase_t(cfg, ns, out, g, i):
                                   ns.snap_term),
             is_req_snap_digest=_put(out.is_req_snap_digest, p, use_is,
                                     ns.snap_digest),
+            is_req_snap_voters=_put(out.is_req_snap_voters, p, use_is,
+                                    ns.snap_voters),
         )
         prev = ns.next_index[p] - 1
         n = jnp.minimum(cfg.max_entries_per_msg, ns.last_index - prev)
@@ -378,9 +419,12 @@ def _phase_t(cfg, ns, out, g, i):
                                     jnp.stack(ents_p)),
         )
 
-    # Election timeout (non-leaders).
+    # Election timeout (non-leaders; non-voters never campaign —
+    # node.py phase_t's is_voter gate).
+    voters0, _ = _current_config(cfg, ns)
+    self_voter = ((voters0 >> i) & 1) == 1
     ee = ns.election_elapsed + 1
-    timeout = ~is_leader & (ee >= ns.deadline)
+    timeout = ~is_leader & (ee >= ns.deadline) & self_voter
     ns = ns._replace(election_elapsed=jnp.where(is_leader,
                                                 ns.election_elapsed, ee))
     ns = ns._replace(
@@ -391,27 +435,52 @@ def _phase_t(cfg, ns, out, g, i):
         votes=jnp.where(timeout, jnp.arange(cfg.k) == i, ns.votes),
     )
     ns = _reset_timer(cfg, ns, g, i, timeout)
-    if cfg.majority == 1:
-        ns = _become_leader(cfg, ns, i, timeout)
-    else:
-        llt = _last_log_term(cfg, ns)
-        for p in range(cfg.k):
-            cond = timeout & (i != p)
-            out = out._replace(
-                rv_req_present=_put(out.rv_req_present, p, cond, True),
-                rv_req_term=_put(out.rv_req_term, p, cond, ns.term),
-                rv_req_lli=_put(out.rv_req_lli, p, cond, ns.last_index),
-                rv_req_llt=_put(out.rv_req_llt, p, cond, llt),
-            )
+    # Instant win (single-voter config — `Node._start_election`'s
+    # post-self-vote quorum check); else broadcast RequestVote.
+    won = timeout & quorum.vote_won(ns.votes, voters0, cfg.k)
+    ns = _become_leader(cfg, ns, i, won)
+    llt = _last_log_term(cfg, ns)
+    for p in range(cfg.k):
+        cond = timeout & ~won & (i != p)
+        out = out._replace(
+            rv_req_present=_put(out.rv_req_present, p, cond, True),
+            rv_req_term=_put(out.rv_req_term, p, cond, ns.term),
+            rv_req_lli=_put(out.rv_req_lli, p, cond, ns.last_index),
+            rv_req_llt=_put(out.rv_req_llt, p, cond, llt),
+        )
     return ns, out
 
 
 # ----------------------------------------------------------------- phase C
 
 
-def _phase_c(cfg, ns, g):
-    """`Node.phase_c` (node.py:348): leader appends client commands."""
+def _phase_c(cfg, ns, g, t):
+    """`Node.phase_c`: scheduled membership proposal (DESIGN.md §2b),
+    then client command appends."""
     lead = ns.role == LEADER
+
+    if cfg.reconfig_u32:
+        # `Node._maybe_propose_reconfig`: first tick of a firing epoch.
+        epoch = t // cfg.reconfig_epoch
+        fires = ((t % cfg.reconfig_epoch) == 0) & jrng.reconfig_fires(
+            cfg.seed, g, epoch, cfg.reconfig_u32)
+        target = jrng.reconfig_target(cfg.seed, g, epoch, cfg.k)
+        voters, cfg_index = _current_config(cfg, ns)
+        new_mask = voters ^ jnp.left_shift(jnp.int32(1), target)
+        gate = ((quorum.popcount(new_mask) >= cfg.effective_min_voters)
+                & (cfg_index <= ns.commit)
+                & (_term_at(cfg, ns, ns.commit) == ns.term))
+        idx = ns.last_index + 1
+        room = (idx - ns.snap_index) <= cfg.log_cap
+        do = lead & fires & gate & room
+        s = _slot(cfg, idx)
+        ns = ns._replace(
+            log_term=_lset(ns.log_term, s, do, ns.term),
+            log_payload=_lset(ns.log_payload, s, do,
+                              jnp.int32(CONFIG_FLAG) | new_mask),
+            last_index=jnp.where(do, idx, ns.last_index),
+        )
+
     last_index = ns.last_index
     log_term, log_payload = ns.log_term, ns.log_payload
     stopped = jnp.zeros((), BOOL)
@@ -433,14 +502,27 @@ def _phase_c(cfg, ns, g):
 
 
 def _phase_a(cfg, ns, i):
-    """`Node.phase_a` (node.py:359): commit advance, apply, compact."""
-    n = quorum.commit_candidate(ns.match_index, ns.last_index, i,
-                                cfg.k, cfg.majority)
+    """`Node.phase_a`: voters-aware commit advance, removed-leader
+    step-down, apply, compact."""
+    voters, cfg_index = _current_config(cfg, ns)
+    n = quorum.commit_candidate_voters(ns.match_index, ns.last_index, i,
+                                       voters, cfg.k)
     # §5.4.2: current-term entries only. n > commit >= snap_index makes the
-    # term_at read valid under the mask.
+    # term_at read valid under the mask (n == -1 when no voters exist,
+    # which the n > commit guard also rejects).
     advance = ((ns.role == LEADER) & (n > ns.commit)
                & (_term_at(cfg, ns, n) == ns.term))
     commit = jnp.where(advance, n, ns.commit)
+
+    # A removed leader steps down once its removal is committed
+    # (node.py phase_a): latest config entry committed, self not in it.
+    self_voter = ((voters >> i) & 1) == 1
+    demote = (ns.role == LEADER) & (cfg_index <= commit) & ~self_voter
+    ns = ns._replace(
+        role=jnp.where(demote, FOLLOWER, ns.role),
+        leader_id=jnp.where(demote, NO_VOTE, ns.leader_id),
+        votes=jnp.where(demote, False, ns.votes),
+    )
 
     # Apply loop: commit - applied <= L by the window invariant, so an
     # L-step unrolled chain covers it. The digest chain is inherently
@@ -458,6 +540,8 @@ def _phase_a(cfg, ns, i):
     return ns._replace(
         commit=commit, applied=applied, digest=digest,
         snap_term=jnp.where(compact, _term_at(cfg, ns, commit), ns.snap_term),
+        snap_voters=jnp.where(compact, _committed_voters(cfg, ns, commit),
+                              ns.snap_voters),
         snap_index=jnp.where(compact, commit, ns.snap_index),
         snap_digest=jnp.where(compact, digest, ns.snap_digest),
     )
@@ -466,16 +550,17 @@ def _phase_a(cfg, ns, i):
 # ------------------------------------------------------------ per-node tick
 
 
-def _node_tick(cfg, ns: PerNode, inbox: Mailbox, g, i):
+def _node_tick(cfg, t, ns: PerNode, inbox: Mailbox, g, i):
     """One node's full D/T/C/A tick. `inbox` leaves lead with [K_src];
-    the returned outbox leaves lead with [K_dst]."""
+    the returned outbox leaves lead with [K_dst]. `t` is the absolute
+    tick (the reconfig schedule hashes it)."""
     out = empty_mailbox((cfg.k,), cfg.max_entries_per_msg)
     # Phase D: canonical (type, src) order — node.py:154 + rpc.sort_inbox.
     for handler in _HANDLERS:
         for src in range(cfg.k):
             ns, out = handler(cfg, ns, out, g, i, src, inbox)
     ns, out = _phase_t(cfg, ns, out, g, i)
-    ns = _phase_c(cfg, ns, g)
+    ns = _phase_c(cfg, ns, g, t)
     ns = _phase_a(cfg, ns, i)
     return ns, out
 
@@ -549,7 +634,7 @@ def tick(cfg: RaftConfig, st: State, t) -> State:
     # layout with no whole-mailbox transpose between ticks.
     inbox = _filter_mailbox(cfg, st.mailbox, t, alive_now, st.group_id)
 
-    node_fn = functools.partial(_node_tick, cfg)
+    node_fn = functools.partial(_node_tick, cfg, t)
     new_nodes, outbox = jax.vmap(jax.vmap(node_fn, out_axes=(0, 1)))(
         nodes, inbox, g_grid, i_grid)
 

@@ -74,7 +74,19 @@ def link_partitioned(seed, g, tick, src, dst, partition_u32: int, partition_epoc
 
 
 def client_payload(seed, g, term, index):
-    return (hash_u32(seed, _r.TAG_CMD, g, term, index) & jnp.uint32(0x7FFFFFFF)).astype(jnp.int32)
+    # 30-bit: the CONFIG_FLAG bit must stay clear (see utils/rng.py).
+    return (hash_u32(seed, _r.TAG_CMD, g, term, index) & jnp.uint32(0x3FFFFFFF)).astype(jnp.int32)
+
+
+def reconfig_fires(seed, g, epoch, reconfig_u32: int):
+    if reconfig_u32 == 0:
+        return jnp.zeros(_full_shape(g, epoch), jnp.bool_)
+    return hash_u32(seed, _r.TAG_RECONFIG, g, epoch) < jnp.uint32(reconfig_u32)
+
+
+def reconfig_target(seed, g, epoch, k: int):
+    return (hash_u32(seed, _r.TAG_RECONFIG_NODE, g, epoch)
+            % jnp.uint32(k)).astype(jnp.int32)
 
 
 def digest_update(digest, index, payload):

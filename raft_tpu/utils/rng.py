@@ -24,6 +24,8 @@ TAG_CRASH = 3     # per-node per-epoch crash schedule
 TAG_PART = 4      # per-group per-epoch partition active?
 TAG_PART_SIDE = 5  # per-node partition side assignment
 TAG_CMD = 6       # client command payloads
+TAG_RECONFIG = 7       # per-group per-epoch membership-change proposal?
+TAG_RECONFIG_NODE = 8  # which node's membership the proposal toggles
 
 
 def mix32(x: int) -> int:
@@ -74,9 +76,20 @@ def link_partitioned(seed: int, g: int, tick: int, src: int, dst: int,
 def client_payload(seed: int, g: int, term: int, index: int) -> int:
     """Deterministic opaque payload for the entry at (group, term, index).
 
-    Kept in int32 range so numpy/JAX int32 lanes hold it exactly.
+    30-bit so the CONFIG_FLAG bit (config.py) stays clear: a client
+    payload can never be mistaken for a membership-change entry.
     """
-    return hash_u32(seed, TAG_CMD, g, term, index) & 0x7FFFFFFF
+    return hash_u32(seed, TAG_CMD, g, term, index) & 0x3FFFFFFF
+
+
+def reconfig_fires(seed: int, g: int, epoch: int, reconfig_u32: int) -> bool:
+    """Does the membership-change schedule propose at this epoch?"""
+    return hash_u32(seed, TAG_RECONFIG, g, epoch) < reconfig_u32
+
+
+def reconfig_target(seed: int, g: int, epoch: int, k: int) -> int:
+    """Which node's membership the epoch's proposal toggles."""
+    return hash_u32(seed, TAG_RECONFIG_NODE, g, epoch) % k
 
 
 def digest_update(digest: int, index: int, payload: int) -> int:

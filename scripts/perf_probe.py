@@ -1,0 +1,157 @@
+"""Ablation timing of the batched tick: where does a tick's time go?
+
+Times a 200-tick scanned chunk on the default platform (the real TPU
+under the driver) with individual phases of the tick knocked out by
+monkeypatching `raft_tpu.sim.step` internals. The tick graph is static
+— masks, not branches — so knocking a phase out and diffing wall time
+measures that phase's cost including its fusion effects. Results feed
+DESIGN.md §7 ("where a tick's time goes") and BENCH history.
+
+Usage: python scripts/perf_probe.py [--groups 50000 100000] [--variants ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+import raft_tpu.sim.step as step_mod
+from raft_tpu import sim
+from raft_tpu.config import RaftConfig
+from raft_tpu.sim.run import Metrics, metrics_init, metrics_update
+from raft_tpu.sim.state import I32
+
+CHUNK = 200
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+# The module's `tick` is jitted with its own trace cache, which would
+# ignore monkeypatched internals — always trace through the raw function.
+_raw_tick = step_mod.tick.__wrapped__
+
+
+def make_runner(cfg, with_metrics: str):
+    """with_metrics: 'full' | 'nohist' | 'none'."""
+
+    @jax.jit
+    def go(st, m, t0):
+        def body(carry, t):
+            s, mm = carry
+            s = _raw_tick(cfg, s, t)
+            if with_metrics == "full":
+                mm = metrics_update(mm, s)
+            elif with_metrics == "nohist":
+                nodes = s.nodes
+                committed = jnp.maximum(mm.committed,
+                                        jnp.max(nodes.commit, axis=1))
+                mm = Metrics(committed=committed, leaderless=mm.leaderless,
+                             elections=mm.elections, hist=mm.hist)
+            return (s, mm), None
+
+        (st2, m2), _ = jax.lax.scan(
+            body, (st, m), t0 + jnp.arange(CHUNK, dtype=I32))
+        return st2, m2
+
+    return go
+
+
+ORIG = dict(handlers=step_mod._HANDLERS, phase_t=step_mod._phase_t,
+            phase_c=step_mod._phase_c, phase_a=step_mod._phase_a)
+
+
+def apply_variant(name: str) -> str:
+    """Patch step internals for the named ablation; returns metrics mode."""
+    step_mod._HANDLERS = ORIG["handlers"]
+    step_mod._phase_t = ORIG["phase_t"]
+    step_mod._phase_c = ORIG["phase_c"]
+    step_mod._phase_a = ORIG["phase_a"]
+    if name == "full":
+        return "full"
+    if name == "nometrics":
+        return "none"
+    if name == "nohist":
+        return "nohist"
+    if name == "nophaseD":
+        step_mod._HANDLERS = ()
+        return "full"
+    if name == "nophaseT":
+        step_mod._phase_t = lambda cfg, ns, out, g, i: (ns, out)
+        return "full"
+    if name == "nophaseC":
+        step_mod._phase_c = lambda cfg, ns, g, t: ns
+        return "full"
+    if name == "noapply":
+        def commit_only(cfg, ns, i):
+            from raft_tpu.core.node import LEADER
+            from raft_tpu.ops import quorum
+            n = quorum.commit_candidate(ns.match_index, ns.last_index, i,
+                                        cfg.k, cfg.majority)
+            advance = ((ns.role == LEADER) & (n > ns.commit)
+                       & (step_mod._term_at(cfg, ns, n) == ns.term))
+            return ns._replace(commit=jnp.where(advance, n, ns.commit))
+        step_mod._phase_a = commit_only
+        return "full"
+    raise ValueError(name)
+
+
+def run_variant(name: str, n_groups: int, chunks: int = 3):
+    mode = apply_variant(name)
+    cfg = RaftConfig(seed=42)
+    st = sim.init(cfg, n_groups=n_groups)
+    m = metrics_init(n_groups)
+    go = make_runner(cfg, mode)
+    t0 = time.perf_counter()
+    st, m = go(st, m, 0)
+    jax.block_until_ready(st)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    at = CHUNK
+    for _ in range(chunks):
+        st, m = go(st, m, at)
+        at += CHUNK
+    jax.block_until_ready(st)
+    dt = time.perf_counter() - t0
+    tps = chunks * CHUNK / dt
+    log(f"{name:10s} G={n_groups:7d}: {tps:8.1f} ticks/s "
+        f"({dt / (chunks * CHUNK) * 1e3:7.2f} ms/tick, compile+warm "
+        f"{compile_s:5.1f}s)")
+    apply_variant("full")
+    return tps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--groups", type=int, nargs="+",
+                    default=[50_000, 100_000])
+    ap.add_argument("--variants", nargs="+",
+                    default=["full", "nometrics", "nohist", "nophaseD",
+                             "nophaseT", "nophaseC", "noapply"])
+    args = ap.parse_args()
+    dev = jax.devices()[0]
+    log(f"platform: {dev.platform} ({dev.device_kind})")
+    results = {}
+    for g in args.groups:
+        for v in args.variants:
+            results[(v, g)] = run_variant(v, g)
+    for g in args.groups:
+        full = results.get(("full", g))
+        if not full:
+            continue
+        log(f"-- G={g}: attribution vs full ({full:.1f} ticks/s)")
+        for v in args.variants:
+            if v == "full" or (v, g) not in results:
+                continue
+            saved = 1e3 / full - 1e3 / results[(v, g)]
+            log(f"   {v:10s}: {saved:7.2f} ms/tick attributable")
+
+
+if __name__ == "__main__":
+    main()

@@ -22,3 +22,19 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402  (import must follow the env setup above)
 
 jax.config.update("jax_platforms", "cpu")
+
+# The suite's wall time is XLA compile time, not tick execution (~50s
+# compile vs <1s run for a 400-tick differential trace): cache compiled
+# executables on disk so only the first-ever run of each (cfg, shape)
+# program pays it. The cache dir is gitignored and machine-local.
+_cache = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def trees_equal(a, b) -> bool:
+    """Byte-identical pytree comparison (leaf-count mismatch is a fail)."""
+    import numpy as np
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb))

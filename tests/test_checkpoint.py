@@ -1,0 +1,97 @@
+"""Host-side checkpoint/restore (SURVEY.md §5 elastic-recovery row):
+run 100 ticks, save, reload — in THIS process and in a FRESH process —
+run 100 more, and require bit-equality with an unbroken 200-tick run."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from conftest import trees_equal as _trees_equal
+from raft_tpu import sim
+from raft_tpu.config import RaftConfig
+from raft_tpu.sim import checkpoint
+from raft_tpu.sim.run import metrics_init
+
+CFG = dict(seed=6, drop_prob=0.05, crash_prob=0.2, crash_epoch=32)
+
+
+def test_save_load_roundtrip_in_process(tmp_path):
+    cfg = RaftConfig(**CFG)
+    st = sim.init(cfg, n_groups=16)
+    m = metrics_init(16)
+    st, m = sim.run(cfg, st, 100, 0, m)
+    path = tmp_path / "ckpt.npz"
+    checkpoint.save(path, st, 100, m, cfg=cfg)
+    st2, t2, m2 = checkpoint.load(path, cfg=cfg)
+    assert t2 == 100
+    assert _trees_equal(st, st2)
+    assert _trees_equal(m, m2)
+
+    # Continue both and compare against an unbroken 200-tick run.
+    unbroken, mu = sim.run(cfg, sim.init(cfg, n_groups=16), 100)
+    unbroken, mu = sim.run(cfg, unbroken, 100, 100, mu)
+    resumed, mr = sim.run(cfg, st2, 100, t2, m2)
+    assert _trees_equal(unbroken, resumed)
+    assert _trees_equal(mu, mr)
+
+
+def test_load_rejects_config_mismatch(tmp_path):
+    cfg = RaftConfig(**CFG)
+    st = sim.init(cfg, n_groups=4)
+    path = tmp_path / "ckpt.npz"
+    checkpoint.save(path, st, 0, cfg=cfg)
+    other = RaftConfig(**{**CFG, "seed": 7})
+    with pytest.raises(ValueError, match="cfg mismatch"):
+        checkpoint.load(path, cfg=other)
+    # Without a cfg to check against, load is permissive by design.
+    checkpoint.load(path)
+
+
+_CHILD = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+from raft_tpu import sim
+from raft_tpu.config import RaftConfig
+from raft_tpu.sim import checkpoint
+
+cfg = RaftConfig(seed=6, drop_prob=0.05, crash_prob=0.2, crash_epoch=32)
+st, t, m = checkpoint.load(sys.argv[1], cfg=cfg)
+st, m = sim.run(cfg, st, 100, t, m)
+checkpoint.save(sys.argv[2], st, t + 100, m, cfg=cfg)
+"""
+
+
+def test_resume_in_fresh_process(tmp_path):
+    cfg = RaftConfig(**CFG)
+    st = sim.init(cfg, n_groups=16)
+    m = metrics_init(16)
+    st, m = sim.run(cfg, st, 100, 0, m)
+    p1, p2 = tmp_path / "a.npz", tmp_path / "b.npz"
+    checkpoint.save(p1, st, 100, m, cfg=cfg)
+
+    env = dict(os.environ)
+    # Share the compile cache so the child doesn't pay a cold compile.
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+        os.path.dirname(__file__), ".jax_cache")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    subprocess.run([sys.executable, "-c", _CHILD, str(p1), str(p2)],
+                   env=env, check=True)
+
+    st2, t2, m2 = checkpoint.load(p2)
+    assert t2 == 200
+    unbroken, mu = sim.run(cfg, sim.init(cfg, n_groups=16), 100)
+    unbroken, mu = sim.run(cfg, unbroken, 100, 100, mu)
+    assert _trees_equal(unbroken, st2)
+    assert _trees_equal(mu, m2)

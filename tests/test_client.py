@@ -1,0 +1,138 @@
+"""Client API on the CPU oracle (SURVEY.md §2 row 17): propose routed to
+the leader, ReadIndex linearizable reads, read-your-writes across leader
+changes. Pure-Python — no JAX involvement."""
+
+from __future__ import annotations
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.cluster import Cluster
+from raft_tpu.core.node import Node
+
+
+def _elect(c: Cluster, max_ticks: int = 100) -> int:
+    for _ in range(max_ticks):
+        if c.leader() is not None:
+            return c.leader()
+        c.tick()
+    raise AssertionError("no leader elected")
+
+
+def _commit(c: Cluster, ticket, max_ticks: int = 100):
+    for _ in range(max_ticks):
+        if c.is_committed(ticket):
+            return
+        c.tick()
+    raise AssertionError(f"ticket {ticket} never committed")
+
+
+def test_propose_commits_and_applies():
+    # cmds_per_tick=0: the only writes are explicit client proposes.
+    c = Cluster(RaftConfig(seed=50, cmds_per_tick=0))
+    _elect(c)
+    t1 = c.propose(111)
+    t2 = c.propose(222)
+    assert t1 is not None and t2 is not None
+    assert t2[0] == t1[0] + 1   # consecutive indices
+    _commit(c, t1)
+    _commit(c, t2)
+    assert c._committed[t1[0]] == 111
+    assert c._committed[t2[0]] == 222
+
+
+def test_propose_without_leader_returns_none():
+    c = Cluster(RaftConfig(seed=51, cmds_per_tick=0))
+    assert c.leader() is None   # tick 0: nobody elected yet
+    assert c.propose(1) is None
+
+
+def test_propose_flow_control_when_window_full():
+    cfg = RaftConfig(seed=52, cmds_per_tick=0, log_cap=8, compact_every=4)
+    c = Cluster(cfg)
+    _elect(c)
+    lead = c.nodes[c.leader()]
+    # Fill the leader's window without letting replication advance.
+    accepted = 0
+    while c.propose(1000 + accepted) is not None:
+        accepted += 1
+    assert accepted <= cfg.log_cap - (lead.snap_index - lead.snap_index)
+    # After ticking (replication + compaction), proposals flow again.
+    c.run(20)
+    assert c.propose(42) is not None
+
+
+def test_linearizable_read_basic():
+    c = Cluster(RaftConfig(seed=53, cmds_per_tick=0))
+    _elect(c)
+    t1 = c.propose(777)
+    _commit(c, t1)
+    r = c.read()
+    assert r is not None
+    read_index, served_index, digest = r
+    assert read_index >= t1[0]
+    assert served_index >= read_index
+    assert digest == c.expected_digest(served_index)
+
+
+def test_read_your_writes_across_leader_change():
+    """The VERDICT-mandated sequence: propose -> crash the leader ->
+    re-election -> read on the new leader sees the write."""
+    cfg = RaftConfig(seed=54, cmds_per_tick=0)
+    c = Cluster(cfg)
+    old = _elect(c)
+    ticket = c.propose(31337)
+    assert ticket is not None
+    _commit(c, ticket)
+
+    # Crash the old leader permanently; everyone else stays up.
+    c.alive_fn = lambda t, dead=old: [i != dead for i in range(cfg.k)]
+    for _ in range(200):
+        c.tick()
+        lead = c.leader()
+        if lead is not None and lead != old:
+            break
+    assert c.leader() is not None and c.leader() != old
+
+    r = c.read()
+    assert r is not None
+    read_index, served_index, digest = r
+    # The new leader's read covers the old leader's committed write...
+    assert read_index >= ticket[0]
+    assert c._committed[ticket[0]] == 31337
+    # ...and serves exactly the state machine the commit history implies.
+    assert digest == c.expected_digest(served_index)
+
+
+def test_read_aborts_on_leader_crash():
+    cfg = RaftConfig(seed=55, cmds_per_tick=0)
+    c = Cluster(cfg)
+    old = _elect(c)
+    handle = c.read_begin()
+    assert handle is not None and handle[0] == old
+    c.alive_fn = lambda t, dead=old: [i != dead for i in range(cfg.k)]
+    c.tick()
+    assert c.read_poll(handle) == Node.READ_ABORTED
+    # A fresh read on the new regime still completes.
+    assert c.read(max_ticks=300) is not None
+
+
+def test_read_requires_quorum_roundtrip():
+    """A leader cut off from all peers must never serve a ReadIndex read:
+    with every link down post-registration, the read stays pending."""
+    cfg = RaftConfig(seed=56, cmds_per_tick=0)
+    c = Cluster(cfg)
+    _elect(c)
+    c.run(10)
+    handle = c.read_begin()
+    assert handle is not None
+    # Sever every link from/to the leader from now on.
+    lead = handle[0]
+    c.transport.link_filter = (
+        lambda t, s, d, L=lead: s != L and d != L)
+    pend = 0
+    for _ in range(cfg.election_min + cfg.election_range + 10):
+        r = c.read_poll(handle)
+        assert r in (Node.READ_PENDING, Node.READ_ABORTED), (
+            f"read served without quorum: {r}")
+        pend += r == Node.READ_PENDING
+        c.tick()
+    assert pend > 0

@@ -80,6 +80,17 @@ def test_differential_partitions():
     run_lockstep(cfg, n_groups=2, ticks=500)
 
 
+def test_differential_all_faults():
+    """Fast-tier all-faults run: every fault class on, 400 ticks. A
+    different seed from the slow 1000-tick gate, so a full run (-m "")
+    covers two universes rather than a prefix twice."""
+    cfg = RaftConfig(seed=24, drop_prob=0.05, crash_prob=0.2, crash_epoch=48,
+                     partition_prob=0.3, partition_epoch=64)
+    clusters, _ = run_lockstep(cfg, n_groups=2, ticks=400)
+    assert all(max(n.commit for n in c.nodes) > 10 for c in clusters)
+
+
+@pytest.mark.slow
 def test_differential_all_faults_long():
     """The §7-step-3 headline run: >=1K ticks with every fault class on."""
     cfg = RaftConfig(seed=23, drop_prob=0.05, crash_prob=0.2, crash_epoch=48,
@@ -100,6 +111,22 @@ def test_differential_small_window():
 def test_differential_k3():
     cfg = RaftConfig(seed=31, k=3, drop_prob=0.1)
     run_lockstep(cfg, n_groups=2, ticks=400)
+
+
+def test_differential_reconfig():
+    """Membership-change fault class: the scheduled reconfig churns the
+    voter set (with crashes forcing re-elections under changed quorums)
+    and the two backends must stay bit-identical — including the
+    snap_voters surface once compaction folds a config entry."""
+    cfg = RaftConfig(seed=37, reconfig_prob=0.9, reconfig_epoch=32,
+                     crash_prob=0.2, crash_epoch=48)
+    clusters, _ = run_lockstep(cfg, n_groups=3, ticks=600)
+    # The schedule must actually have churned membership somewhere.
+    full = (1 << cfg.k) - 1
+    assert any(n.current_config()[0] != full
+               for c in clusters for n in c.nodes) or any(
+        n.snap_voters != full for c in clusters for n in c.nodes), (
+        "reconfig never fired — differential coverage is vacuous")
 
 
 def test_comparator_has_teeth():

@@ -1,0 +1,216 @@
+"""Single-server membership change on the CPU oracle (SURVEY.md §2
+row 16, DESIGN.md §2b): add/remove voters via config log entries,
+voters-aware quorums, removed-leader step-down, and the single-server
+gating rules. Safety checkers (election safety, commit identity) run on
+every tick via the Cluster harness."""
+
+from __future__ import annotations
+
+import pytest
+
+from raft_tpu.config import CONFIG_FLAG, RaftConfig
+from raft_tpu.core.cluster import Cluster
+from raft_tpu.core.node import FOLLOWER, LEADER
+
+
+def _elect(c: Cluster, max_ticks: int = 200) -> int:
+    for _ in range(max_ticks):
+        if c.leader() is not None:
+            return c.leader()
+        c.tick()
+    raise AssertionError("no leader elected")
+
+
+def _commit(c: Cluster, ticket, max_ticks: int = 200):
+    for _ in range(max_ticks):
+        if c.is_committed(ticket):
+            return
+        c.tick()
+    raise AssertionError(f"ticket {ticket} never committed")
+
+
+def _settle(c: Cluster, ticks: int = 30):
+    c.run(ticks)
+    _elect(c)
+
+
+FULL = 0b11111   # k = 5
+
+
+def test_remove_follower_commits_and_shrinks_quorum():
+    c = Cluster(RaftConfig(seed=60))
+    _settle(c)
+    lead = c.leader()
+    victim = (lead + 1) % 5
+    t = c.propose_reconfig(FULL ^ (1 << victim))
+    assert t is not None and t[1] == (CONFIG_FLAG | (FULL ^ (1 << victim)))
+    _commit(c, t)
+    voters, _ = c.nodes[lead].current_config()
+    assert voters == FULL ^ (1 << victim)
+    # Liveness with the removed node AND one voter down: 3 of 4 voters
+    # remain, which is a majority of the new config (but would NOT have
+    # been one worth counting under the old 5-node config's rules if the
+    # removed node were still required).
+    other = (lead + 2) % 5
+    if other == victim:
+        other = (lead + 3) % 5
+    c.alive_fn = lambda tk: [i != victim and i != other for i in range(5)]
+    before = max(n.commit for n in c.nodes)
+    c.run(60)
+    assert max(n.commit for n in c.nodes) > before
+
+
+def test_removed_node_never_starts_elections():
+    c = Cluster(RaftConfig(seed=61))
+    _settle(c)
+    lead = c.leader()
+    victim = (lead + 1) % 5
+    t = c.propose_reconfig(FULL ^ (1 << victim))
+    assert t is not None
+    _commit(c, t)
+    # Partition the removed node away so it would, as a voter, campaign.
+    c.transport.link_filter = (
+        lambda tk, s, d, v=victim: s != v and d != v)
+    terms_before = c.nodes[victim].term
+    for _ in range(200):
+        c.tick()
+        assert c.nodes[victim].role == FOLLOWER
+    # It never bumped its own term through timeouts.
+    assert c.nodes[victim].term == terms_before
+
+
+def test_remove_leader_steps_down_and_regime_continues():
+    c = Cluster(RaftConfig(seed=62))
+    _settle(c)
+    old = c.leader()
+    t = c.propose_reconfig(FULL ^ (1 << old))
+    assert t is not None
+    _commit(c, t)
+    # Step-down happens in the commit tick's phase A.
+    assert c.nodes[old].role == FOLLOWER
+    # A new leader emerges from the remaining voters and commits.
+    for _ in range(200):
+        c.tick()
+        lead = c.leader()
+        if lead is not None and lead != old:
+            break
+    assert lead is not None and lead != old
+    before = max(n.commit for n in c.nodes)
+    c.run(40)
+    assert max(n.commit for n in c.nodes) > before
+
+
+def test_add_server_back():
+    c = Cluster(RaftConfig(seed=63))
+    _settle(c)
+    lead = c.leader()
+    victim = (lead + 1) % 5
+    t = c.propose_reconfig(FULL ^ (1 << victim))
+    assert t is not None
+    _commit(c, t)
+    lead = _elect(c)
+    t2 = c.propose_reconfig(FULL)
+    assert t2 is not None
+    _commit(c, t2)
+    voters, _ = c.nodes[lead].current_config()
+    assert voters == FULL
+    # The re-added node campaigns and can be elected again eventually.
+    assert c.nodes[victim].is_voter()
+
+
+def test_gate_rejects_double_delta_and_inflight():
+    c = Cluster(RaftConfig(seed=64))
+    _settle(c)
+    lead = c.leader()
+    # Two-server delta: rejected.
+    assert c.nodes[lead].propose_config(FULL ^ 0b11) is None
+    # Valid single-server change...
+    t = c.propose_reconfig(FULL ^ 0b1 if lead != 0 else FULL ^ 0b10)
+    assert t is not None
+    # ...blocks a second one until the first commits.
+    mask2 = FULL ^ (1 << ((lead + 2) % 5))
+    assert c.nodes[lead].propose_config(mask2) is None
+    _commit(c, t)
+
+
+def test_gate_requires_current_term_commit():
+    """A fresh leader must commit an entry of its own term before any
+    membership change (single-server bugfix)."""
+    cfg = RaftConfig(seed=65, cmds_per_tick=0)
+    c = Cluster(cfg)
+    old = _elect(c)
+    tk = c.propose(42)
+    assert tk is not None
+    _commit(c, tk)
+    # Depose the leader; elect a new one with no current-term commit yet.
+    c.alive_fn = lambda t, dead=old: [i != dead for i in range(5)]
+    for _ in range(300):
+        c.tick()
+        lead = c.leader()
+        if lead is not None and lead != old:
+            break
+    assert lead is not None and lead != old
+    n = c.nodes[lead]
+    if n.term_at(n.commit) != n.term:
+        # Gate must hold while the takeover entry is still uncommitted.
+        assert n.propose_config(FULL ^ (1 << old)) is None
+    # Once a current-term entry commits, the gate opens.
+    tk2 = c.propose(43)
+    assert tk2 is not None
+    _commit(c, tk2)
+    assert c.nodes[c.leader()].propose_config(
+        FULL ^ (1 << ((c.leader() + 1) % 5))) is not None
+
+
+def test_scheduled_reconfig_universe_is_safe_and_live():
+    """The deterministic schedule drives membership churn; harness
+    invariants (election safety, commit identity) must hold throughout
+    and the group must keep committing."""
+    cfg = RaftConfig(seed=66, reconfig_prob=0.9, reconfig_epoch=32,
+                     crash_prob=0.15, crash_epoch=48, drop_prob=0.02)
+    c = Cluster(cfg)
+    c.run(1200)   # safety checkers raise on any violation
+    assert max(n.commit for n in c.nodes) > 100
+    # The schedule actually changed membership at least once.
+    masks = {n.current_config()[0] for n in c.nodes}
+    assert (masks != {FULL}
+            or any(n.snap_voters != FULL for n in c.nodes)), (
+        "reconfig schedule never fired — test is vacuous")
+
+
+def test_snapshot_carries_config():
+    """A compaction folding a config entry must preserve it via
+    snap_voters, and InstallSnapshot must transfer it to laggards."""
+    cfg = RaftConfig(seed=67, compact_every=4, log_cap=16)
+    c = Cluster(cfg)
+    _settle(c)
+    lead = c.leader()
+    victim = (lead + 1) % 5
+    # Crash the victim BEFORE the change so it must learn it by snapshot.
+    c.alive_fn = lambda tk, v=victim: [i != v for i in range(5)]
+    c.run(2)
+    new_mask = FULL ^ (1 << victim)
+    t = c.propose_reconfig(new_mask)
+    assert t is not None
+    _commit(c, t)
+    # Run long enough that compaction passes the config entry.
+    c.run(80)
+    lead = c.leader()
+    assert c.nodes[lead].snap_voters == new_mask
+    # Revive the victim; it catches up (possibly via InstallSnapshot)
+    # and learns it is no longer a voter.
+    c.alive_fn = None
+    c.run(120)
+    assert not c.nodes[victim].is_voter()
+    assert c.nodes[victim].current_config()[0] == new_mask
+
+
+@pytest.mark.parametrize("seed", [70, 71, 72])
+def test_no_split_brain_across_change(seed):
+    """Heavy churn + reconfig: the per-term unique-leader checker and
+    the commit-identity checker must stay silent."""
+    cfg = RaftConfig(seed=seed, reconfig_prob=0.8, reconfig_epoch=24,
+                     crash_prob=0.25, crash_epoch=32,
+                     partition_prob=0.25, partition_epoch=40,
+                     drop_prob=0.05)
+    Cluster(cfg).run(800)

@@ -8,15 +8,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from conftest import trees_equal as _trees_equal
 from raft_tpu import sim
 from raft_tpu.config import RaftConfig
 from raft_tpu.sim import check
 from raft_tpu.sim.run import latency_quantile
-
-
-def _trees_equal(a, b):
-    return all(np.array_equal(np.asarray(x), np.asarray(y))
-               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
 
 
 def test_elects_and_commits_1k_groups():
