@@ -64,11 +64,34 @@ class RaftConfig:
     reconfig_epoch: int = 64
     min_voters: int = 0
 
+    # Scheduled linearizable reads (DESIGN.md §2c): every `read_every`
+    # ticks the leader registers a ReadIndex read (dissertation §6.4) at
+    # the start of phase C; it completes in a later tick's phase A once
+    # a CURRENT-config voter majority has acked at ticks >= reg + 2 and
+    # the state machine has applied through the read point, incrementing
+    # the node's `reads_done` counter (part of the differential trace
+    # surface). 0 = off (statically absent from both backends' programs).
+    read_every: int = 0
+
+    # PreVote (Raft dissertation §9.6): before bumping its term, a
+    # timed-out node runs a non-binding pre-ballot at term+1; peers grant
+    # only if the log is up-to-date AND they have not heard from a leader
+    # within election_min ticks (the lease check). Prevents a rejoining
+    # partitioned node from inflating terms and deposing a healthy
+    # leader. Static flag: when False, the pre-vote machinery is absent
+    # from both backends' programs (no new messages, identical traces).
+    prevote: bool = False
+
     def __post_init__(self):
         assert self.k >= 1
         assert self.election_range >= 1
         assert self.heartbeat_every >= 1
         assert self.max_entries_per_msg >= 1
+        # The batched AE entry walk (sim/step.py) relies on one message's
+        # E consecutive indices occupying pairwise-distinct ring slots.
+        assert self.max_entries_per_msg <= self.log_cap, (
+            "max_entries_per_msg must not exceed log_cap"
+        )
         # The window must fit a burst of appends plus compaction slack.
         assert self.log_cap >= self.compact_every + self.cmds_per_tick + 1, (
             "log_cap must cover compact_every + cmds_per_tick + 1 or the "

@@ -29,6 +29,7 @@ class NodeView:
     snap_index: int
     snap_term: int
     snap_voters: int
+    reads_done: int
     alive: bool
 
 
@@ -225,5 +226,6 @@ class Cluster:
                          leader_id=n.leader_id, last_index=n.last_index,
                          commit=n.commit, applied=n.applied, digest=n.digest,
                          snap_index=n.snap_index, snap_term=n.snap_term,
-                         snap_voters=n.snap_voters, alive=self.alive_prev[i])
+                         snap_voters=n.snap_voters, reads_done=n.reads_done,
+                         alive=self.alive_prev[i])
                 for i, n in enumerate(self.nodes)]

@@ -11,8 +11,10 @@ import dataclasses
 from typing import List, Tuple
 
 # Canonical type order for phase D. The TPU path unrolls its handler loop in
-# exactly this order.
-RV_REQ, RV_RESP, AE_REQ, AE_RESP, IS_REQ, IS_RESP = range(6)
+# exactly this order. Pre-vote types come last so that enabling
+# `cfg.prevote` leaves the processing order of the original six
+# unchanged (prevote-off traces are bit-identical to older builds).
+RV_REQ, RV_RESP, AE_REQ, AE_RESP, IS_REQ, IS_RESP, PV_REQ, PV_RESP = range(8)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,6 +68,26 @@ class InstallSnapshotReq(Msg):
 class InstallSnapshotResp(Msg):
     term: int = 0
     match: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PreVoteReq(Msg):
+    """Non-binding pre-ballot probe (dissertation §9.6): `term` is the
+    PROPOSED next term (sender's term + 1); the sender has not bumped its
+    own term. Receivers never adopt this term."""
+    term: int = 0
+    last_log_index: int = 0
+    last_log_term: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PreVoteResp(Msg):
+    """`term` is the responder's CURRENT term (authoritative — a higher
+    one steps the pre-candidate down); `req_term` echoes the proposed
+    term so a grant can be matched to the pre-ballot that asked."""
+    term: int = 0
+    req_term: int = 0
+    granted: bool = False
 
 
 def inbox_sort_key(m: Msg):

@@ -14,6 +14,7 @@ sim/state.py). `tests/test_parallel.py` pins bit-identity between an
 
 from __future__ import annotations
 
+import sys
 from typing import NamedTuple
 
 import jax
@@ -29,26 +30,35 @@ AXIS = "g"
 
 
 def _pvary(x, axis):
-    """Mark `x` as varying over `axis` (API name moved across jax versions)."""
-    if hasattr(jax.lax, "pvary"):
-        return jax.lax.pvary(x, (axis,))
-    return jax.lax.pcast(x, (axis,), to="varying")
+    """Mark `x` as varying over `axis` (API name moved across jax versions:
+    prefer the current `pcast`; `pvary` is the deprecated spelling)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis,), to="varying")
+    return jax.lax.pvary(x, (axis,))
 
 
-def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+def make_mesh(n_devices: int | None = None, devices=None,
+              allow_cpu_fallback: bool = False) -> Mesh:
     """1-D mesh over the first `n_devices` of `devices`.
 
-    Falls back to the virtual CPU platform when the default platform has
-    too few devices (the TPU plugin in this image exposes a single chip;
-    the 8-way CPU split is the multi-chip test vehicle)."""
+    When the default platform has too few devices (the TPU plugin in
+    this image exposes a single chip), the caller must OPT IN to the
+    virtual-CPU fallback with `allow_cpu_fallback=True` — silently
+    swapping platforms would let a benchmark measure the wrong hardware.
+    Without the flag, asking for more devices than exist raises."""
     if devices is None:
         devices = jax.devices()
-        if n_devices is not None and len(devices) < n_devices:
+        if (n_devices is not None and len(devices) < n_devices
+                and allow_cpu_fallback):
+            print(f"make_mesh: default platform has {len(devices)} "
+                  f"device(s) < {n_devices}; falling back to the virtual "
+                  f"CPU platform", file=sys.stderr)
             devices = jax.devices("cpu")
     if n_devices is not None:
         if len(devices) < n_devices:
             raise ValueError(
-                f"need {n_devices} devices, have {len(devices)}")
+                f"need {n_devices} devices, have {len(devices)} "
+                f"(pass allow_cpu_fallback=True for the CPU test vehicle)")
         devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (AXIS,))
 

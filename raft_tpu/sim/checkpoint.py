@@ -33,6 +33,8 @@ def _flatten(prefix: str, obj, out: dict):
     if hasattr(obj, "_fields"):   # NamedTuple node
         for f in obj._fields:
             _flatten(f"{prefix}{f}.", getattr(obj, f), out)
+    elif obj is None:
+        pass   # empty pytree slot (e.g. Mailbox pv_* with prevote off)
     else:
         out[prefix[:-1]] = np.asarray(obj)
 
@@ -54,8 +56,23 @@ def save(path, st: State, t: int, metrics: Optional[Metrics] = None,
     np.savez(path, **flat)
 
 
+OPTIONAL_FIELDS = frozenset(
+    f for f in Mailbox._fields if f.startswith("pv_"))
+
+
 def _load_nt(z, prefix: str, cls):
-    return cls(**{f: jnp.asarray(z[f"{prefix}{f}"]) for f in cls._fields})
+    """Legitimately-optional fields (the prevote Mailbox slots, absent
+    when `cfg.prevote` is off — skipped by `_flatten` on save) load as
+    None; any OTHER missing field is a corrupt/incompatible checkpoint
+    and raises immediately, naming the field."""
+    def get(f):
+        key = f"{prefix}{f}"
+        if key not in z.files:
+            if f in OPTIONAL_FIELDS:
+                return None
+            raise KeyError(f"checkpoint missing field {key!r}")
+        return jnp.asarray(z[key])
+    return cls(**{f: get(f) for f in cls._fields})
 
 
 def load(path, cfg: Optional[RaftConfig] = None

@@ -95,7 +95,7 @@ def run(cfg: RaftConfig, st: State, n_ticks: int, t0=0,
 
 TRACE_FIELDS = ("term", "role", "voted_for", "leader_id", "last_index",
                 "commit", "applied", "digest", "snap_index", "snap_term",
-                "snap_voters")
+                "snap_voters", "reads_done")
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2))

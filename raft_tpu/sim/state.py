@@ -62,6 +62,14 @@ class PerNode(NamedTuple):
     election_elapsed: jnp.ndarray   # i32
     heartbeat_elapsed: jnp.ndarray  # i32
     deadline: jnp.ndarray     # i32
+    leader_elapsed: jnp.ndarray     # i32 — PreVote lease clock (node.py)
+    # Scheduled-read state (DESIGN.md §2c; node.py `sched_read` /
+    # `ack_time` / `reads_done`). Always present for a stable trace
+    # surface; all writes are statically gated on `cfg.read_every`.
+    ack_time: jnp.ndarray           # i32[K] — last current-term resp tick
+    sched_read_index: jnp.ndarray   # i32 — read point, -1 = none
+    sched_read_reg: jnp.ndarray     # i32 — registration tick
+    reads_done: jnp.ndarray         # i32 — completed linearizable reads
 
 
 class Mailbox(NamedTuple):
@@ -82,14 +90,22 @@ class Mailbox(NamedTuple):
     rv_resp_term: jnp.ndarray     # i32
     rv_resp_granted: jnp.ndarray  # bool
 
+    # AppendEntries carries NO entry payloads on the batched path: the
+    # receiver pulls the n entries straight out of the sender's ring
+    # (sim/step.py `_on_ae_req`), which is bit-exact because the covered
+    # range (prev, prev+n] cannot change between the send (phase T of
+    # tick t) and the delivery (phase D of t+1 reads end-of-t state):
+    # phase C appends strictly above it, phase A never writes the ring,
+    # and ring-slot collisions with new appends would need an index gap
+    # of L, impossible inside one bounded window. This deletes the
+    # send-side gather (the single hottest op group, DESIGN.md §7) and
+    # two [G, K, K, E] arrays from the scan carry.
     ae_req_present: jnp.ndarray   # bool
     ae_req_term: jnp.ndarray      # i32
     ae_req_prev_index: jnp.ndarray  # i32
     ae_req_prev_term: jnp.ndarray   # i32
     ae_req_n: jnp.ndarray         # i32 — number of valid entries
     ae_req_commit: jnp.ndarray    # i32 — leader_commit
-    ae_req_ent_term: jnp.ndarray     # i32[..., E]
-    ae_req_ent_payload: jnp.ndarray  # i32[..., E]
 
     ae_resp_present: jnp.ndarray  # bool
     ae_resp_term: jnp.ndarray     # i32
@@ -107,6 +123,19 @@ class Mailbox(NamedTuple):
     is_resp_term: jnp.ndarray     # i32
     is_resp_match: jnp.ndarray    # i32
 
+    # PreVote slots — present only when `cfg.prevote` (None otherwise:
+    # a None NamedTuple field is an empty pytree subtree, so the
+    # prevote-off program carries zero extra arrays and stays
+    # byte-identical to builds that predate the feature).
+    pv_req_present: jnp.ndarray | None = None   # bool
+    pv_req_term: jnp.ndarray | None = None      # i32 — PROPOSED term
+    pv_req_lli: jnp.ndarray | None = None       # i32
+    pv_req_llt: jnp.ndarray | None = None       # i32
+    pv_resp_present: jnp.ndarray | None = None  # bool
+    pv_resp_term: jnp.ndarray | None = None     # i32 — responder's term
+    pv_resp_req_term: jnp.ndarray | None = None  # i32 — echoed proposal
+    pv_resp_granted: jnp.ndarray | None = None  # bool
+
 
 class State(NamedTuple):
     nodes: PerNode        # leaves [G, K, ...]
@@ -119,26 +148,33 @@ class State(NamedTuple):
     # [0, G_local), silently duplicating universes.
 
 
-def empty_mailbox(lead_shape: tuple, e: int) -> Mailbox:
+def empty_mailbox(lead_shape: tuple, prevote: bool = False) -> Mailbox:
     """Zero mailbox with the given leading shape: `(g, k, k)` for the
     in-flight buffer ([G, dst, src]), `(k,)` for a per-node outbox inside
-    the vmapped step (entry fields get a trailing [E])."""
+    the vmapped step. PreVote slots are materialized only when
+    `prevote`."""
     def z(dtype, *extra):
         return jnp.zeros(tuple(lead_shape) + extra, dtype)
 
+    pv = {}
+    if prevote:
+        pv = dict(pv_req_present=z(BOOL), pv_req_term=z(I32),
+                  pv_req_lli=z(I32), pv_req_llt=z(I32),
+                  pv_resp_present=z(BOOL), pv_resp_term=z(I32),
+                  pv_resp_req_term=z(I32), pv_resp_granted=z(BOOL))
     return Mailbox(
         rv_req_present=z(BOOL), rv_req_term=z(I32), rv_req_lli=z(I32),
         rv_req_llt=z(I32),
         rv_resp_present=z(BOOL), rv_resp_term=z(I32), rv_resp_granted=z(BOOL),
         ae_req_present=z(BOOL), ae_req_term=z(I32), ae_req_prev_index=z(I32),
         ae_req_prev_term=z(I32), ae_req_n=z(I32), ae_req_commit=z(I32),
-        ae_req_ent_term=z(I32, e), ae_req_ent_payload=z(I32, e),
         ae_resp_present=z(BOOL), ae_resp_term=z(I32), ae_resp_success=z(BOOL),
         ae_resp_match=z(I32),
         is_req_present=z(BOOL), is_req_term=z(I32), is_req_snap_index=z(I32),
         is_req_snap_term=z(I32), is_req_snap_digest=z(U32),
         is_req_snap_voters=z(I32),
         is_resp_present=z(BOOL), is_resp_term=z(I32), is_resp_match=z(I32),
+        **pv,
     )
 
 
@@ -174,10 +210,15 @@ def init(cfg: RaftConfig, n_groups: int | None = None) -> State:
         match_index=z(I32, k),
         election_elapsed=z(I32), heartbeat_elapsed=z(I32),
         deadline=deadline,
+        leader_elapsed=z(I32),
+        ack_time=jnp.full((g, k, k), -1, I32),
+        sched_read_index=jnp.full((g, k), -1, I32),
+        sched_read_reg=z(I32),
+        reads_done=z(I32),
     )
     return State(
         nodes=nodes,
-        mailbox=empty_mailbox((g, k, k), cfg.max_entries_per_msg),
+        mailbox=empty_mailbox((g, k, k), cfg.prevote),
         alive_prev=jnp.ones((g, k), BOOL),
         group_id=jnp.arange(g, dtype=I32),
     )

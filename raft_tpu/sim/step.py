@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.config import CONFIG_FLAG, RaftConfig
-from raft_tpu.core.node import CANDIDATE, FOLLOWER, LEADER, NO_VOTE
+from raft_tpu.core.node import (CANDIDATE, FOLLOWER, LEADER, NO_VOTE,
+                                PRECANDIDATE)
 from raft_tpu.ops import quorum
 from raft_tpu.sim.state import (BOOL, I32, Mailbox, PerNode, State,
                                 empty_mailbox)
@@ -85,9 +86,12 @@ def _put(arr, p: int, cond, val):
 def _abs_index(cfg, ns: PerNode):
     """i32[L]: the absolute index each live-window ring slot holds
     (>= snap_index + 1 by construction; slots beyond last_index are
-    stale and must be masked by the caller)."""
-    return ns.snap_index + 1 + (
-        jnp.arange(cfg.log_cap, dtype=I32) - ns.snap_index) % cfg.log_cap
+    stale and must be masked by the caller). The modulo is taken on the
+    per-node SCALAR and expanded with a compare+select: an [L]-wide
+    integer remainder is a multi-op sequence on TPU that measurably
+    dominated phase D when tried (DESIGN.md §7)."""
+    off = jnp.arange(cfg.log_cap, dtype=I32) - ns.snap_index % cfg.log_cap
+    return ns.snap_index + 1 + jnp.where(off >= 0, off, off + cfg.log_cap)
 
 
 def _config_scan(cfg, ns: PerNode, through):
@@ -108,11 +112,33 @@ def _config_scan(cfg, ns: PerNode, through):
 
 
 def _current_config(cfg, ns: PerNode):
+    # Static fast path (round-4 VERDICT item 1): with the reconfig
+    # schedule statically off, no CONFIG_FLAG payload can ever enter any
+    # log — the only batched-path source is `_phase_c`'s scheduled
+    # proposal, itself gated on `cfg.reconfig_u32`. The config is then a
+    # compile-time constant, and returning it here lets XLA fold every
+    # downstream voter computation (vote quorums, commit tallies,
+    # self-voter gates, removed-leader demotion) out of the tick program
+    # instead of paying ~7 O(L) ring scans per node per tick.
+    if cfg.reconfig_u32 == 0:
+        return jnp.int32(cfg.full_mask), ns.snap_index
     return _config_scan(cfg, ns, jnp.int32(0x7FFFFFFF))
 
 
 def _committed_voters(cfg, ns: PerNode, commit):
+    if cfg.reconfig_u32 == 0:
+        return jnp.int32(cfg.full_mask)
     return _config_scan(cfg, ns, commit)[0]
+
+
+def _vote_quorum(cfg, ns: PerNode, votes):
+    """`Node._vote_quorum`: granted votes from CURRENT-config voters
+    reach that config's majority. The single static-vs-dynamic branch
+    point for every election path (RV tally, PV tally, instant win)."""
+    if cfg.reconfig_u32 == 0:   # static full-config quorum (fast path)
+        return quorum.vote_count(votes) >= cfg.majority
+    voters, _ = _current_config(cfg, ns)
+    return quorum.vote_won(votes, voters, cfg.k)
 
 
 # -------------------------------------------------------------- transitions
@@ -129,20 +155,34 @@ def _reset_timer(cfg, ns: PerNode, g, i, cond):
     )
 
 
-def _step_down(ns: PerNode, new_term, cond):
-    """`Node._step_down` (node.py:96): adopt term, follower, no timer reset."""
+def _drop_reads(cfg, ns: PerNode, cond):
+    """`Node._drop_client_state` for the scheduled-read fields: pending
+    read aborts, deference evidence is stale. Statically absent when the
+    read schedule is off."""
+    if not cfg.read_every:
+        return ns
     return ns._replace(
+        ack_time=jnp.where(cond, -1, ns.ack_time),
+        sched_read_index=jnp.where(cond, -1, ns.sched_read_index),
+    )
+
+
+def _step_down(cfg, ns: PerNode, new_term, cond):
+    """`Node._step_down` (node.py:96): adopt term, follower, no timer reset."""
+    ns = ns._replace(
         term=jnp.where(cond, new_term, ns.term),
         role=jnp.where(cond, FOLLOWER, ns.role),
         voted_for=jnp.where(cond, NO_VOTE, ns.voted_for),
         leader_id=jnp.where(cond, NO_VOTE, ns.leader_id),
         votes=jnp.where(cond, False, ns.votes),
     )
+    return _drop_reads(cfg, ns, cond)
 
 
 def _become_leader(cfg, ns: PerNode, i, cond):
     """`Node._become_leader` (node.py:104) incl. the takeover re-proposal
     (DESIGN.md §2a): the TOP entry takes the new term in place."""
+    ns = _drop_reads(cfg, ns, cond)
     ns = ns._replace(
         role=jnp.where(cond, LEADER, ns.role),
         leader_id=jnp.where(cond, i, ns.leader_id),
@@ -162,6 +202,7 @@ def _accept_leader(cfg, ns: PerNode, g, i, src: int, cond):
         role=jnp.where(cond, FOLLOWER, ns.role),
         leader_id=jnp.where(cond, src, ns.leader_id),
         votes=jnp.where(cond, False, ns.votes),
+        leader_elapsed=jnp.where(cond, 0, ns.leader_elapsed),
     )
     return _reset_timer(cfg, ns, g, i, cond)
 
@@ -169,12 +210,12 @@ def _accept_leader(cfg, ns: PerNode, g, i, src: int, cond):
 # ----------------------------------------------------------------- phase D
 
 
-def _on_rv_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
+def _on_rv_req(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
     """`Node._on_rv_req` (node.py:169)."""
     present = ib.rv_req_present[src]
     m_term, m_lli, m_llt = (ib.rv_req_term[src], ib.rv_req_lli[src],
                             ib.rv_req_llt[src])
-    ns = _step_down(ns, m_term, present & (m_term > ns.term))
+    ns = _step_down(cfg, ns, m_term, present & (m_term > ns.term))
     llt = _last_log_term(cfg, ns)
     log_ok = (m_llt > llt) | ((m_llt == llt) & (m_lli >= ns.last_index))
     grant = (present & (m_term == ns.term)
@@ -190,33 +231,45 @@ def _on_rv_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
     return ns, out
 
 
-def _on_rv_resp(cfg, ns, out, g, i, src: int, ib: Mailbox):
+def _on_rv_resp(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
     """`Node._on_rv_resp` (node.py:184)."""
     present = ib.rv_resp_present[src]
     m_term, m_granted = ib.rv_resp_term[src], ib.rv_resp_granted[src]
     higher = present & (m_term > ns.term)
-    ns = _step_down(ns, m_term, higher)
+    ns = _step_down(cfg, ns, m_term, higher)
     cont = (present & ~higher & (ns.role == CANDIDATE)
             & (m_term == ns.term) & m_granted)
     votes = ns.votes.at[src].set(ns.votes[src] | cont)
     ns = ns._replace(votes=votes)
-    voters, _ = _current_config(cfg, ns)
-    won = cont & quorum.vote_won(votes, voters, cfg.k)
+    won = cont & _vote_quorum(cfg, ns, votes)
     return _become_leader(cfg, ns, i, won), out
 
 
-def _on_ae_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
-    """`Node._on_ae_req` (node.py:201): the log-matching workhorse."""
+def _on_ae_req(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
+    """`Node._on_ae_req` (node.py:201): the log-matching workhorse.
+
+    Entry payloads are PULLED from the sender's ring (`gl` — the whole
+    group's end-of-previous-tick log arrays, [K, L]) rather than carried
+    in the message; see the Mailbox docstring for the bit-exactness
+    argument. `gl[0][src]` / `gl[1][src]` are the sender's term/payload
+    rings with `src` static, so each entry read is one masked reduce of
+    a group-broadcast array — far cheaper than the send-side gather
+    loop this replaces."""
+    glog_t, glog_p, _ = gl
     present = ib.ae_req_present[src]
     m_term = ib.ae_req_term[src]
     m_prev = ib.ae_req_prev_index[src]
     m_prev_term = ib.ae_req_prev_term[src]
     m_n = ib.ae_req_n[src]
     m_commit = ib.ae_req_commit[src]
-    ent_t = ib.ae_req_ent_term[src]       # [E]
-    ent_p = ib.ae_req_ent_payload[src]    # [E]
+    # The j-th sent entry has absolute index m_prev+1+j; its value lives
+    # at the sender's ring slot for that index (valid under j < m_n).
+    ent_t = [_lget(glog_t[src], _slot(cfg, m_prev + 1 + j))
+             for j in range(cfg.max_entries_per_msg)]
+    ent_p = [_lget(glog_p[src], _slot(cfg, m_prev + 1 + j))
+             for j in range(cfg.max_entries_per_msg)]
 
-    ns = _step_down(ns, m_term, present & (m_term > ns.term))
+    ns = _step_down(cfg, ns, m_term, present & (m_term > ns.term))
     stale = present & (m_term < ns.term)
     ok = present & ~stale
     ns = _accept_leader(cfg, ns, g, i, src, ok)
@@ -230,8 +283,7 @@ def _on_ae_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
     # past the highest in-window index BELOW m_prev whose term differs
     # from ct (clamped to snap_index when the run reaches the snapshot).
     ct = _term_at(cfg, ns, m_prev)
-    absidx = ns.snap_index + 1 + (
-        jnp.arange(cfg.log_cap, dtype=I32) - ns.snap_index) % cfg.log_cap
+    absidx = _abs_index(cfg, ns)   # scalar-mod form — see its docstring
     bad = ((absidx > ns.snap_index) & (absidx < m_prev)
            & (ns.log_term != ct))
     # min with m_prev covers the degenerate m_prev == snap_index case,
@@ -240,27 +292,35 @@ def _on_ae_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
                      m_prev)
 
     proceed = ok & ~past & ~conflict
-    # Entry walk (node.py:229-256). Entries at idx <= snap_index are
-    # committed here hence match (Log Matching) — skipped via j0.
+    # Entry walk (node.py:229-256), split decide-then-write: this handler
+    # alone was ~51% of the whole tick (DESIGN.md §7), dominated by the
+    # E chained read-modify-write ring passes below. Entries at idx <=
+    # snap_index are committed here hence match (Log Matching) — skipped
+    # via j0.
     j0 = jnp.maximum(0, ns.snap_index - m_prev)
     hi = m_prev + j0
     last_index = ns.last_index
-    log_term, log_payload = ns.log_term, ns.log_payload
     stopped = jnp.zeros((), BOOL)
+    # Stage 1 — decide: per-entry scalar chain. Reads go to the ORIGINAL
+    # log arrays: the E entries address E consecutive absolute indices,
+    # whose ring slots are pairwise distinct (E <= L, config invariant),
+    # so within one message no write feeds a later read.
+    write_t, write_p, slots = [], [], []   # per-entry write masks + slots
     for j in range(cfg.max_entries_per_msg):
         idx = m_prev + 1 + j
         act = proceed & (j >= j0) & (j < m_n) & ~stopped
         s = _slot(cfg, idx)
+        slots.append(s)
         in_log = act & (idx <= last_index)
         # act => idx > snap_index, so a direct slot read IS term_at(idx).
-        same_t = in_log & (_lget(log_term, s) == ent_t[j])
-        same_p = in_log & ~same_t & (_lget(log_payload, s) == ent_p[j])
+        same_t = in_log & (_lget(ns.log_term, s) == ent_t[j])
+        same_p = in_log & ~same_t & (_lget(ns.log_payload, s) == ent_p[j])
         diverge = in_log & ~same_t & ~same_p   # truncate, then append
         need_append = (act & ~in_log) | diverge
         room = (idx - ns.snap_index) <= cfg.log_cap
         do_append = need_append & room
-        log_term = _lset(log_term, s, same_p | do_append, ent_t[j])
-        log_payload = _lset(log_payload, s, do_append, ent_p[j])
+        write_t.append(same_p | do_append)
+        write_p.append(do_append)
         # Truncation (divergent suffix) is just lowering last_index in the
         # ring model; append then restores it to idx when there is room.
         last_index = jnp.where(
@@ -268,6 +328,25 @@ def _on_ae_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
             jnp.where(diverge & ~room, idx - 1, last_index))
         stopped = stopped | (need_append & ~room)
         hi = jnp.where(same_t | same_p | do_append, idx, hi)
+    # Stage 2 — commit all decisions in ONE masked pass per array. Each
+    # entry's ring slot is a per-node scalar from stage 1; the slots are
+    # pairwise distinct, so the E one-hot masks compose with no ordering.
+    # (No modulo over the lane axis here: TPU integer remainder is a
+    # multi-op sequence, and an [L]-wide one measurably dominated the
+    # whole tick when tried.)
+    lanes = jnp.arange(cfg.log_cap, dtype=I32)
+    t_mask = jnp.zeros((cfg.log_cap,), BOOL)
+    p_mask = jnp.zeros((cfg.log_cap,), BOOL)
+    t_val = jnp.zeros((cfg.log_cap,), I32)
+    p_val = jnp.zeros((cfg.log_cap,), I32)
+    for j in range(cfg.max_entries_per_msg):
+        on_j = lanes == slots[j]
+        t_mask = t_mask | (on_j & write_t[j])
+        p_mask = p_mask | (on_j & write_p[j])
+        t_val = jnp.where(on_j, ent_t[j], t_val)
+        p_val = jnp.where(on_j, ent_p[j], p_val)
+    log_term = jnp.where(t_mask, t_val, ns.log_term)
+    log_payload = jnp.where(p_mask, p_val, ns.log_payload)
 
     commit = jnp.where(
         proceed & (m_commit > ns.commit),
@@ -288,15 +367,20 @@ def _on_ae_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
     return ns, out
 
 
-def _on_ae_resp(cfg, ns, out, g, i, src: int, ib: Mailbox):
+def _on_ae_resp(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
     """`Node._on_ae_resp` (node.py:263)."""
     present = ib.ae_resp_present[src]
     m_term = ib.ae_resp_term[src]
     m_success = ib.ae_resp_success[src]
     m_match = ib.ae_resp_match[src]
     higher = present & (m_term > ns.term)
-    ns = _step_down(ns, m_term, higher)
+    ns = _step_down(cfg, ns, m_term, higher)
     cont = present & ~higher & (ns.role == LEADER) & (m_term == ns.term)
+    if cfg.read_every:
+        # Any current-term response is ReadIndex deference evidence
+        # (node.py:339): stamp the arrival tick, success or not.
+        ns = ns._replace(ack_time=ns.ack_time.at[src].set(
+            jnp.where(cont, gl[2], ns.ack_time[src])))
     succ = cont & m_success
     fail = cont & ~m_success
     new_match = jnp.maximum(ns.match_index[src], m_match)
@@ -310,7 +394,7 @@ def _on_ae_resp(cfg, ns, out, g, i, src: int, ib: Mailbox):
     return ns._replace(match_index=match_index, next_index=next_index), out
 
 
-def _on_is_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
+def _on_is_req(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
     """`Node._on_is_req` (node.py:275)."""
     present = ib.is_req_present[src]
     m_term = ib.is_req_term[src]
@@ -318,7 +402,7 @@ def _on_is_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
     m_st = ib.is_req_snap_term[src]
     m_sd = ib.is_req_snap_digest[src]
     m_sv = ib.is_req_snap_voters[src]
-    ns = _step_down(ns, m_term, present & (m_term > ns.term))
+    ns = _step_down(cfg, ns, m_term, present & (m_term > ns.term))
     stale = present & (m_term < ns.term)
     ok = present & ~stale
     ns = _accept_leader(cfg, ns, g, i, src, ok)
@@ -348,14 +432,17 @@ def _on_is_req(cfg, ns, out, g, i, src: int, ib: Mailbox):
     return ns, out
 
 
-def _on_is_resp(cfg, ns, out, g, i, src: int, ib: Mailbox):
+def _on_is_resp(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
     """`Node._on_is_resp` (node.py:305)."""
     present = ib.is_resp_present[src]
     m_term = ib.is_resp_term[src]
     m_match = ib.is_resp_match[src]
     higher = present & (m_term > ns.term)
-    ns = _step_down(ns, m_term, higher)
+    ns = _step_down(cfg, ns, m_term, higher)
     cont = present & ~higher & (ns.role == LEADER) & (m_term == ns.term)
+    if cfg.read_every:
+        ns = ns._replace(ack_time=ns.ack_time.at[src].set(
+            jnp.where(cont, gl[2], ns.ack_time[src])))
     new_match = jnp.maximum(ns.match_index[src], m_match)
     match_index = ns.match_index.at[src].set(
         jnp.where(cont, new_match, ns.match_index[src]))
@@ -364,8 +451,77 @@ def _on_is_resp(cfg, ns, out, g, i, src: int, ib: Mailbox):
     return ns._replace(match_index=match_index, next_index=next_index), out
 
 
+def _start_election_masked(cfg, ns, out, g, i, cond):
+    """`Node._start_election` under a mask: term bump, candidacy, fresh
+    timer draw, instant single-voter win, RequestVote broadcast. Shared
+    by the pre-vote quorum path (phase D) and phase T's skip case."""
+    ns = ns._replace(
+        term=jnp.where(cond, ns.term + 1, ns.term),
+        role=jnp.where(cond, CANDIDATE, ns.role),
+        voted_for=jnp.where(cond, i, ns.voted_for),
+        leader_id=jnp.where(cond, NO_VOTE, ns.leader_id),
+        votes=jnp.where(cond, jnp.arange(cfg.k) == i, ns.votes),
+    )
+    ns = _reset_timer(cfg, ns, g, i, cond)
+    won = cond & _vote_quorum(cfg, ns, ns.votes)   # instant single-voter win
+    ns = _become_leader(cfg, ns, i, won)
+    llt = _last_log_term(cfg, ns)
+    for p in range(cfg.k):
+        send = cond & ~won & (i != p)
+        out = out._replace(
+            rv_req_present=_put(out.rv_req_present, p, send, True),
+            rv_req_term=_put(out.rv_req_term, p, send, ns.term),
+            rv_req_lli=_put(out.rv_req_lli, p, send, ns.last_index),
+            rv_req_llt=_put(out.rv_req_llt, p, send, llt),
+        )
+    return ns, out
+
+
+def _on_pv_req(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
+    """`Node._on_pv_req`: non-binding pre-vote grant — proposed term
+    ahead, log up-to-date, not the leader, lease expired. No term
+    adoption, no voted_for, no timer reset."""
+    if not cfg.prevote:
+        return ns, out
+    present = ib.pv_req_present[src]
+    m_term, m_lli, m_llt = (ib.pv_req_term[src], ib.pv_req_lli[src],
+                            ib.pv_req_llt[src])
+    llt = _last_log_term(cfg, ns)
+    log_ok = (m_llt > llt) | ((m_llt == llt) & (m_lli >= ns.last_index))
+    grant = (present & (m_term > ns.term) & log_ok & (ns.role != LEADER)
+             & (ns.leader_elapsed >= cfg.election_min))
+    out = out._replace(
+        pv_resp_present=_put(out.pv_resp_present, src, present, True),
+        pv_resp_term=_put(out.pv_resp_term, src, present, ns.term),
+        pv_resp_req_term=_put(out.pv_resp_req_term, src, present, m_term),
+        pv_resp_granted=_put(out.pv_resp_granted, src, present, grant),
+    )
+    return ns, out
+
+
+def _on_pv_resp(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
+    """`Node._on_pv_resp`: tally pre-votes; a quorum starts the REAL
+    election (term bump + RequestVote broadcast) right here in phase D,
+    exactly as the CPU oracle's `_start_election` call does."""
+    if not cfg.prevote:
+        return ns, out
+    present = ib.pv_resp_present[src]
+    m_term = ib.pv_resp_term[src]
+    m_req = ib.pv_resp_req_term[src]
+    m_granted = ib.pv_resp_granted[src]
+    higher = present & (m_term > ns.term)
+    ns = _step_down(cfg, ns, m_term, higher)
+    cont = (present & ~higher & (ns.role == PRECANDIDATE)
+            & (m_req == ns.term + 1) & m_granted)
+    votes = ns.votes.at[src].set(ns.votes[src] | cont)
+    ns = ns._replace(votes=votes)
+    won_pre = cont & _vote_quorum(cfg, ns, votes)
+    return _start_election_masked(cfg, ns, out, g, i, won_pre)
+
+
 _HANDLERS = (_on_rv_req, _on_rv_resp, _on_ae_req, _on_ae_resp,
-             _on_is_req, _on_is_resp)   # canonical rpc type order
+             _on_is_req, _on_is_resp, _on_pv_req, _on_pv_resp)
+#             canonical rpc type order (PV last — rpc.py)
 
 
 # ----------------------------------------------------------------- phase T
@@ -396,15 +552,12 @@ def _phase_t(cfg, ns, out, g, i):
             is_req_snap_voters=_put(out.is_req_snap_voters, p, use_is,
                                     ns.snap_voters),
         )
+        # No entry gather: the receiver pulls (prev, prev+n] out of this
+        # sender's ring at delivery time (see Mailbox docstring) — the
+        # send-side gather loop this replaces was the hottest op group
+        # in the whole tick (DESIGN.md §7).
         prev = ns.next_index[p] - 1
         n = jnp.minimum(cfg.max_entries_per_msg, ns.last_index - prev)
-        ents_t, ents_p = [], []
-        for j in range(cfg.max_entries_per_msg):
-            idx = prev + 1 + j
-            valid = use_ae & (j < n)
-            s = _slot(cfg, idx)
-            ents_t.append(jnp.where(valid, _lget(ns.log_term, s), 0))
-            ents_p.append(jnp.where(valid, _lget(ns.log_payload, s), 0))
         out = out._replace(
             ae_req_present=_put(out.ae_req_present, p, use_ae, True),
             ae_req_term=_put(out.ae_req_term, p, use_ae, ns.term),
@@ -413,51 +566,66 @@ def _phase_t(cfg, ns, out, g, i):
                                   _term_at(cfg, ns, prev)),
             ae_req_n=_put(out.ae_req_n, p, use_ae, n),
             ae_req_commit=_put(out.ae_req_commit, p, use_ae, ns.commit),
-            ae_req_ent_term=_put(out.ae_req_ent_term, p, use_ae,
-                                 jnp.stack(ents_t)),
-            ae_req_ent_payload=_put(out.ae_req_ent_payload, p, use_ae,
-                                    jnp.stack(ents_p)),
         )
 
     # Election timeout (non-leaders; non-voters never campaign —
-    # node.py phase_t's is_voter gate).
-    voters0, _ = _current_config(cfg, ns)
-    self_voter = ((voters0 >> i) & 1) == 1
+    # node.py phase_t's is_voter gate). With reconfig statically off,
+    # everyone is a voter and the gate vanishes. The PreVote lease clock
+    # follows node.py phase_t: leaders zero it, everyone else counts up.
     ee = ns.election_elapsed + 1
-    timeout = ~is_leader & (ee >= ns.deadline) & self_voter
-    ns = ns._replace(election_elapsed=jnp.where(is_leader,
-                                                ns.election_elapsed, ee))
+    timeout = ~is_leader & (ee >= ns.deadline)
+    if cfg.reconfig_u32:
+        voters0, _ = _current_config(cfg, ns)
+        timeout = timeout & (((voters0 >> i) & 1) == 1)
     ns = ns._replace(
-        term=jnp.where(timeout, ns.term + 1, ns.term),
-        role=jnp.where(timeout, CANDIDATE, ns.role),
-        voted_for=jnp.where(timeout, i, ns.voted_for),
-        leader_id=jnp.where(timeout, NO_VOTE, ns.leader_id),
-        votes=jnp.where(timeout, jnp.arange(cfg.k) == i, ns.votes),
-    )
-    ns = _reset_timer(cfg, ns, g, i, timeout)
-    # Instant win (single-voter config — `Node._start_election`'s
-    # post-self-vote quorum check); else broadcast RequestVote.
-    won = timeout & quorum.vote_won(ns.votes, voters0, cfg.k)
-    ns = _become_leader(cfg, ns, i, won)
-    llt = _last_log_term(cfg, ns)
-    for p in range(cfg.k):
-        cond = timeout & ~won & (i != p)
-        out = out._replace(
-            rv_req_present=_put(out.rv_req_present, p, cond, True),
-            rv_req_term=_put(out.rv_req_term, p, cond, ns.term),
-            rv_req_lli=_put(out.rv_req_lli, p, cond, ns.last_index),
-            rv_req_llt=_put(out.rv_req_llt, p, cond, llt),
+        election_elapsed=jnp.where(is_leader, ns.election_elapsed, ee),
+        leader_elapsed=jnp.where(is_leader, 0, ns.leader_elapsed + 1))
+    if cfg.prevote:
+        # `Node._start_prevote`: pre-candidacy, no term bump; the
+        # single-voter config skips straight to the real election
+        # (matching the CPU's nested `_start_election` call, including
+        # its second deadline draw).
+        ns = ns._replace(
+            role=jnp.where(timeout, PRECANDIDATE, ns.role),
+            leader_id=jnp.where(timeout, NO_VOTE, ns.leader_id),
+            votes=jnp.where(timeout, jnp.arange(cfg.k) == i, ns.votes),
         )
-    return ns, out
+        ns = _reset_timer(cfg, ns, g, i, timeout)
+        skip = timeout & _vote_quorum(cfg, ns, ns.votes)
+        ns, out = _start_election_masked(cfg, ns, out, g, i, skip)
+        llt = _last_log_term(cfg, ns)
+        for p in range(cfg.k):
+            send = timeout & ~skip & (i != p)
+            out = out._replace(
+                pv_req_present=_put(out.pv_req_present, p, send, True),
+                pv_req_term=_put(out.pv_req_term, p, send, ns.term + 1),
+                pv_req_lli=_put(out.pv_req_lli, p, send, ns.last_index),
+                pv_req_llt=_put(out.pv_req_llt, p, send, llt),
+            )
+        return ns, out
+    return _start_election_masked(cfg, ns, out, g, i, timeout)
 
 
 # ----------------------------------------------------------------- phase C
 
 
 def _phase_c(cfg, ns, g, t):
-    """`Node.phase_c`: scheduled membership proposal (DESIGN.md §2b),
-    then client command appends."""
+    """`Node.phase_c`: scheduled read registration (DESIGN.md §2c),
+    scheduled membership proposal (DESIGN.md §2b), then client command
+    appends."""
     lead = ns.role == LEADER
+
+    if cfg.read_every:
+        # `Node._maybe_schedule_read`: START of phase C, so the read
+        # point is the pre-append commit index; gated like read_begin.
+        gate = ((ns.commit == ns.last_index)
+                | (_term_at(cfg, ns, ns.commit) == ns.term))
+        reg = (lead & ((t % cfg.read_every) == 0)
+               & (ns.sched_read_index < 0) & gate)
+        ns = ns._replace(
+            sched_read_index=jnp.where(reg, ns.commit, ns.sched_read_index),
+            sched_read_reg=jnp.where(reg, t, ns.sched_read_reg),
+        )
 
     if cfg.reconfig_u32:
         # `Node._maybe_propose_reconfig`: first tick of a firing epoch.
@@ -504,9 +672,15 @@ def _phase_c(cfg, ns, g, t):
 def _phase_a(cfg, ns, i):
     """`Node.phase_a`: voters-aware commit advance, removed-leader
     step-down, apply, compact."""
-    voters, cfg_index = _current_config(cfg, ns)
-    n = quorum.commit_candidate_voters(ns.match_index, ns.last_index, i,
-                                       voters, cfg.k)
+    if cfg.reconfig_u32 == 0:
+        # Static fast path: full config, compile-time majority; the
+        # removed-leader demotion branch cannot fire and is elided.
+        n = quorum.commit_candidate(ns.match_index, ns.last_index, i,
+                                    cfg.k, cfg.majority)
+    else:
+        voters, cfg_index = _current_config(cfg, ns)
+        n = quorum.commit_candidate_voters(ns.match_index, ns.last_index, i,
+                                           voters, cfg.k)
     # §5.4.2: current-term entries only. n > commit >= snap_index makes the
     # term_at read valid under the mask (n == -1 when no voters exist,
     # which the n > commit guard also rejects).
@@ -514,15 +688,17 @@ def _phase_a(cfg, ns, i):
                & (_term_at(cfg, ns, n) == ns.term))
     commit = jnp.where(advance, n, ns.commit)
 
-    # A removed leader steps down once its removal is committed
-    # (node.py phase_a): latest config entry committed, self not in it.
-    self_voter = ((voters >> i) & 1) == 1
-    demote = (ns.role == LEADER) & (cfg_index <= commit) & ~self_voter
-    ns = ns._replace(
-        role=jnp.where(demote, FOLLOWER, ns.role),
-        leader_id=jnp.where(demote, NO_VOTE, ns.leader_id),
-        votes=jnp.where(demote, False, ns.votes),
-    )
+    if cfg.reconfig_u32:
+        # A removed leader steps down once its removal is committed
+        # (node.py phase_a): latest config entry committed, self not in it.
+        self_voter = ((voters >> i) & 1) == 1
+        demote = (ns.role == LEADER) & (cfg_index <= commit) & ~self_voter
+        ns = ns._replace(
+            role=jnp.where(demote, FOLLOWER, ns.role),
+            leader_id=jnp.where(demote, NO_VOTE, ns.leader_id),
+            votes=jnp.where(demote, False, ns.votes),
+        )
+        ns = _drop_reads(cfg, ns, demote)
 
     # Apply loop: commit - applied <= L by the window invariant, so an
     # L-step unrolled chain covers it. The digest chain is inherently
@@ -537,7 +713,7 @@ def _phase_a(cfg, ns, i):
         applied = jnp.where(act, idx, applied)
 
     compact = (commit - ns.snap_index) >= cfg.compact_every
-    return ns._replace(
+    ns = ns._replace(
         commit=commit, applied=applied, digest=digest,
         snap_term=jnp.where(compact, _term_at(cfg, ns, commit), ns.snap_term),
         snap_voters=jnp.where(compact, _committed_voters(cfg, ns, commit),
@@ -546,19 +722,54 @@ def _phase_a(cfg, ns, i):
         snap_digest=jnp.where(compact, digest, ns.snap_digest),
     )
 
+    if cfg.read_every:
+        # Scheduled-read completion (node.py phase_a end): voters-aware
+        # ReadIndex quorum over the ack evidence; a step-down or demotion
+        # earlier this tick already cleared the pending read.
+        sched = ns.sched_read_index >= 0
+        lanes = jnp.arange(cfg.k, dtype=I32)
+        recent = ns.ack_time >= ns.sched_read_reg + 2
+        if cfg.reconfig_u32 == 0:
+            voter_lane = jnp.ones((cfg.k,), BOOL)
+            self_voter = jnp.ones((), I32)
+            maj = cfg.majority
+        else:
+            voters2, _ = _current_config(cfg, ns)
+            voter_lane = quorum.voter_bits(voters2, cfg.k)
+            self_voter = (voters2 >> i) & 1
+            maj = quorum.voter_majority(voters2)
+        acks = jnp.sum((recent & voter_lane & (lanes != i)).astype(I32), -1)
+        done = (sched & (acks + self_voter >= maj)
+                & (ns.applied >= ns.sched_read_index))
+        ns = ns._replace(
+            reads_done=ns.reads_done + done.astype(I32),
+            sched_read_index=jnp.where(done, -1, ns.sched_read_index),
+        )
+    return ns
+
 
 # ------------------------------------------------------------ per-node tick
 
 
-def _node_tick(cfg, t, ns: PerNode, inbox: Mailbox, g, i):
+def _node_tick(cfg, t, ns: PerNode, inbox: Mailbox, g, i, glog_t, glog_p):
     """One node's full D/T/C/A tick. `inbox` leaves lead with [K_src];
     the returned outbox leaves lead with [K_dst]. `t` is the absolute
-    tick (the reconfig schedule hashes it)."""
-    out = empty_mailbox((cfg.k,), cfg.max_entries_per_msg)
+    tick (the reconfig schedule hashes it). `glog_t`/`glog_p` are the
+    whole GROUP's end-of-previous-tick log rings `[K, L]`, broadcast
+    across the node axis — the receiver-pull source for AppendEntries.
+
+    `i` is TRACED (the vmapped node lane): a variant with a static
+    Python `i` and the node axis unrolled — deleting the provable no-op
+    src==i handler applications — was tried and measured WORSE (21.4 vs
+    15.4 ms/tick at 100K groups, 5x the compile time): [G]-shaped ops
+    lose more to per-op overhead and lost cross-node fusion than the
+    skipped fifth of phase D saves. Keep the [G, K] double-vmap."""
+    out = empty_mailbox((cfg.k,), cfg.prevote)
+    gl = (glog_t, glog_p, t)   # phase-D context: group logs + the clock
     # Phase D: canonical (type, src) order — node.py:154 + rpc.sort_inbox.
     for handler in _HANDLERS:
         for src in range(cfg.k):
-            ns, out = handler(cfg, ns, out, g, i, src, inbox)
+            ns, out = handler(cfg, ns, out, g, i, src, inbox, gl)
     ns, out = _phase_t(cfg, ns, out, g, i)
     ns = _phase_c(cfg, ns, g, t)
     ns = _phase_a(cfg, ns, i)
@@ -585,8 +796,14 @@ def _apply_restart(cfg, nodes: PerNode, g_grid, i_grid, edge):
         match_index=jnp.where(e1, 0, nodes.match_index),
         heartbeat_elapsed=jnp.where(edge, 0, nodes.heartbeat_elapsed),
         election_elapsed=jnp.where(edge, 0, nodes.election_elapsed),
+        leader_elapsed=jnp.where(edge, 0, nodes.leader_elapsed),
         deadline=jnp.where(edge, new_deadline, nodes.deadline),
         rng_draws=nodes.rng_draws + edge.astype(I32),
+        # Scheduled-read state: restart drops client state and zeroes
+        # the volatile reads_done counter (node.py restart).
+        ack_time=jnp.where(e1, -1, nodes.ack_time),
+        sched_read_index=jnp.where(edge, -1, nodes.sched_read_index),
+        reads_done=jnp.where(edge, 0, nodes.reads_done),
     )
 
 
@@ -602,6 +819,10 @@ def _filter_mailbox(cfg, mb: Mailbox, t, alive_now, group_id) -> Mailbox:
                                  cfg.partition_u32, cfg.partition_epoch)
     drop = jrng.link_dropped(cfg.seed, gg, t, src, dst, cfg.drop_u32)
     keep = alive_now[:, :, None] & ~part & ~drop
+    pv = {}
+    if mb.pv_req_present is not None:
+        pv = dict(pv_req_present=mb.pv_req_present & keep,
+                  pv_resp_present=mb.pv_resp_present & keep)
     return mb._replace(
         rv_req_present=mb.rv_req_present & keep,
         rv_resp_present=mb.rv_resp_present & keep,
@@ -609,6 +830,7 @@ def _filter_mailbox(cfg, mb: Mailbox, t, alive_now, group_id) -> Mailbox:
         ae_resp_present=mb.ae_resp_present & keep,
         is_req_present=mb.is_req_present & keep,
         is_resp_present=mb.is_resp_present & keep,
+        **pv,
     )
 
 
@@ -628,15 +850,17 @@ def tick(cfg: RaftConfig, st: State, t) -> State:
                            alive_now & ~st.alive_prev)
 
     # The mailbox lives in [G, dst, src, ...] layout: that is what the
-    # node-axis vmap consumes directly (each node sees its per-sender
-    # inbox), and `out_axes=1` below stacks each node's [K_dst] outbox
-    # with the sender on axis 2 — producing the same [G, dst, src]
-    # layout with no whole-mailbox transpose between ticks.
+    # per-node slice consumes directly (each node sees its per-sender
+    # inbox), and the stacks below put each node's [K_dst] outbox with
+    # the sender on axis 2 — producing the same [G, dst, src] layout
+    # with no whole-mailbox transpose between ticks.
     inbox = _filter_mailbox(cfg, st.mailbox, t, alive_now, st.group_id)
 
     node_fn = functools.partial(_node_tick, cfg, t)
-    new_nodes, outbox = jax.vmap(jax.vmap(node_fn, out_axes=(0, 1)))(
-        nodes, inbox, g_grid, i_grid)
+    new_nodes, outbox = jax.vmap(
+        jax.vmap(node_fn, in_axes=(0, 0, 0, 0, None, None),
+                 out_axes=(0, 1)))(
+        nodes, inbox, g_grid, i_grid, nodes.log_term, nodes.log_payload)
 
     # Dead nodes: state frozen, sends erased (cluster.py:103-119 runs no
     # phase for them; transport keeps their in-flight mail).
@@ -646,6 +870,10 @@ def tick(cfg: RaftConfig, st: State, t) -> State:
 
     new_nodes = jax.tree.map(freeze, new_nodes, nodes)
     src_alive = alive_now[:, None, :]   # sender axis is 2 in [G, dst, src]
+    pv = {}
+    if outbox.pv_req_present is not None:
+        pv = dict(pv_req_present=outbox.pv_req_present & src_alive,
+                  pv_resp_present=outbox.pv_resp_present & src_alive)
     outbox = outbox._replace(
         rv_req_present=outbox.rv_req_present & src_alive,
         rv_resp_present=outbox.rv_resp_present & src_alive,
@@ -653,6 +881,7 @@ def tick(cfg: RaftConfig, st: State, t) -> State:
         ae_resp_present=outbox.ae_resp_present & src_alive,
         is_req_present=outbox.is_req_present & src_alive,
         is_resp_present=outbox.is_resp_present & src_alive,
+        **pv,
     )
     return State(nodes=new_nodes, mailbox=outbox, alive_prev=alive_now,
                  group_id=st.group_id)

@@ -1,0 +1,79 @@
+"""Layout experiment: batch-major vs batch-minor for the tick's op mix.
+
+The batched state is `[G, K, L]` (G ~ 1e5 groups, K = 5 replicas,
+L = 32 ring slots). XLA tiles the two MINOR dims onto the TPU's
+(8 sublane, 128 lane) registers: with K/L minor, a [G, K] array pads
+5 -> 128 lanes (25x waste) and [G, K, L] pads (5, 32) -> (8, 128)
+(6.4x). Putting G minor instead makes every vector op lane-dense.
+
+This probe times the same per-node one-hot select/reduce chain (the
+phase-D workhorse pattern) under both layouts via vmap in_axes alone —
+identical trace, different physical layout — to decide whether flipping
+the state layout is worth the refactor. Results recorded in DESIGN.md §7.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+G, K, L, STEPS, REPS = 100_000, 5, 32, 30, 3
+
+
+def one(lt, idx):
+    """Per-node toy kernel: 8 chained one-hot reads + masked writes over
+    an [L] ring — the shape of _lget/_lset chains in sim/step.py."""
+    lanes = jnp.arange(L, dtype=jnp.int32)
+    for _ in range(8):
+        v = jnp.sum(jnp.where(lanes == idx, lt, 0), -1)
+        lt = jnp.where((lanes == idx) & (v > 0), lt + 1, lt)
+        idx = (idx + v + 1) % L
+    return lt, idx
+
+
+def scanner(f):
+    @jax.jit
+    def go(lt, idx):
+        def body(c, _):
+            return f(*c), None
+        (lt2, idx2), _ = jax.lax.scan(body, (lt, idx), None, length=STEPS)
+        return lt2, idx2
+    return go
+
+
+def bench(name, f, lt, idx):
+    go = scanner(f)
+    out = go(lt, idx)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = go(lt, idx)
+        s = float(jnp.sum(out[0]))   # forces the full result
+        best = min(best, time.perf_counter() - t0)
+    per_step_ms = best / STEPS * 1e3
+    print(f"{name}: {per_step_ms:7.4f} ms/step ({best * 1e3:.3f} ms best of "
+          f"{REPS}, checksum {s:.0f})")
+    return per_step_ms
+
+
+def main():
+    print(f"platform: {jax.devices()[0].device_kind}, G={G} K={K} L={L}")
+    key = jax.random.PRNGKey(0)
+    lt_gkl = jax.random.randint(key, (G, K, L), 0, 5, jnp.int32)
+    idx_gkl = jax.random.randint(key, (G, K), 0, L, jnp.int32)
+    lt_klg = jnp.transpose(lt_gkl, (1, 2, 0))
+    idx_klg = jnp.transpose(idx_gkl, (1, 0))
+
+    f_gkl = jax.vmap(jax.vmap(one))                     # [G, K, L]: G major
+    f_klg = jax.vmap(jax.vmap(one, 0, 0), -1, -1)       # [K, L, G]: G minor
+
+    a = bench("G-major [G,K,L]", f_gkl, lt_gkl, idx_gkl)
+    b = bench("G-minor [K,L,G]", f_klg, lt_klg, idx_klg)
+    print(f"speedup G-minor: {a / b:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

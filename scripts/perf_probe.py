@@ -52,8 +52,7 @@ def make_runner(cfg, with_metrics: str):
                 nodes = s.nodes
                 committed = jnp.maximum(mm.committed,
                                         jnp.max(nodes.commit, axis=1))
-                mm = Metrics(committed=committed, leaderless=mm.leaderless,
-                             elections=mm.elections, hist=mm.hist)
+                mm = mm._replace(committed=committed)
             return (s, mm), None
 
         (st2, m2), _ = jax.lax.scan(
@@ -81,6 +80,28 @@ def apply_variant(name: str) -> str:
         return "nohist"
     if name == "nophaseD":
         step_mod._HANDLERS = ()
+        return "full"
+    if name.startswith("noh_"):
+        # Knock out ONE handler from phase D's chain, attributing its
+        # share: noh_ae_req, noh_ae_resp, noh_rv_req, noh_rv_resp,
+        # noh_is_req, noh_is_resp.
+        target = "_on_" + name[4:]
+        keep = tuple(h for h in ORIG["handlers"] if h.__name__ != target)
+        assert len(keep) < len(ORIG["handlers"]), name
+        step_mod._HANDLERS = keep
+        return "full"
+    if name == "nodigest":
+        # Phase A runs in full but the digest output is frozen, which
+        # lets XLA dead-code-eliminate the L-unrolled sequential digest
+        # hash chain (and its _payload_at reads). The `applied` counter
+        # walk itself still runs — this attributes the DIGEST chain
+        # only, not all of the apply loop.
+        orig_a = ORIG["phase_a"]
+
+        def thin_apply(cfg, ns, i):
+            ns2 = orig_a(cfg, ns, i)
+            return ns2._replace(digest=ns.digest)
+        step_mod._phase_a = thin_apply
         return "full"
     if name == "nophaseT":
         step_mod._phase_t = lambda cfg, ns, out, g, i: (ns, out)

@@ -6,7 +6,7 @@ from __future__ import annotations
 
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.cluster import Cluster
-from raft_tpu.core.node import Node
+from raft_tpu.core.node import LEADER, Node
 
 
 def _elect(c: Cluster, max_ticks: int = 100) -> int:
@@ -51,10 +51,12 @@ def test_propose_flow_control_when_window_full():
     _elect(c)
     lead = c.nodes[c.leader()]
     # Fill the leader's window without letting replication advance.
+    start_index = lead.last_index
     accepted = 0
     while c.propose(1000 + accepted) is not None:
         accepted += 1
-    assert accepted <= cfg.log_cap - (lead.snap_index - lead.snap_index)
+    # Flow control: proposals stop exactly when the bounded window fills.
+    assert accepted == cfg.log_cap - (start_index - lead.snap_index)
     # After ticking (replication + compaction), proposals flow again.
     c.run(20)
     assert c.propose(42) is not None
@@ -113,6 +115,96 @@ def test_read_aborts_on_leader_crash():
     assert c.read_poll(handle) == Node.READ_ABORTED
     # A fresh read on the new regime still completes.
     assert c.read(max_ticks=300) is not None
+
+
+def test_read_not_served_by_deposed_leader_after_shrink():
+    """Round-4 VERDICT confirmed violation, now a regression test: shrink
+    k=5 to 3 voters, partition the old leader with the two removed
+    learners, let the voter side elect a new leader and commit. The old
+    leader keeps collecting the learners' AppendEntries acks, but those
+    acks are from no election quorum — its pending read must NEVER be
+    served (stale read), only stay pending or abort."""
+    cfg = RaftConfig(seed=57, cmds_per_tick=0)
+    c = Cluster(cfg)
+    old = _elect(c)
+    t0 = c.propose(1)
+    assert t0 is not None
+    _commit(c, t0)
+
+    full = (1 << cfg.k) - 1
+    v1, v2 = [i for i in range(cfg.k) if i != old][:2]
+    t1 = c.propose_reconfig(full ^ (1 << v1))
+    assert t1 is not None
+    _commit(c, t1)
+    t2 = c.propose_reconfig(full ^ (1 << v1) ^ (1 << v2))
+    assert t2 is not None
+    _commit(c, t2)
+    voters = full ^ (1 << v1) ^ (1 << v2)
+    assert c.nodes[old].current_config()[0] == voters
+
+    # Partition: {old leader, both learners} | {the other two voters}.
+    side = {old, v1, v2}
+    c.transport.link_filter = (
+        lambda tk, s, d, side=side: (s in side) == (d in side))
+    c.run(2)
+    rid = c.nodes[old].read_begin()
+    assert rid is not None
+
+    # The voter side (2 of 3 current voters) elects a new leader and
+    # commits a write the old leader will never see.
+    a, b = [i for i in range(cfg.k) if (voters >> i) & 1 and i != old]
+    new_lead = None
+    for _ in range(400):
+        c.tick()
+        r = c.nodes[old].read_poll(rid)
+        assert not isinstance(r, tuple), f"stale read served: {r}"
+        for i in (a, b):
+            if c.nodes[i].role == LEADER:
+                new_lead = i
+        if new_lead is not None:
+            break
+    assert new_lead is not None
+    idx = c.nodes[new_lead].propose(99)
+    assert idx is not None
+    for _ in range(100):
+        c.tick()
+        r = c.nodes[old].read_poll(rid)
+        assert not isinstance(r, tuple), f"stale read served: {r}"
+    assert c._committed.get(idx) == 99, "voter side never committed"
+    # Throughout, the learners' acks kept arriving at the old leader —
+    # the voters-aware quorum is what kept the read unserved.
+    assert all(c.nodes[old].ack_time[v] >= 0 for v in (v1, v2))
+
+
+def test_read_completes_in_shrunk_config():
+    """Dual of the violation: a healthy 2-of-3-voter regime must be able
+    to COMPLETE reads (the old full-k threshold stalled them forever)."""
+    cfg = RaftConfig(seed=58, cmds_per_tick=0)
+    c = Cluster(cfg)
+    old = _elect(c)
+    t0 = c.propose(7)
+    assert t0 is not None
+    _commit(c, t0)
+    full = (1 << cfg.k) - 1
+    v1, v2 = [i for i in range(cfg.k) if i != old][:2]
+    t1 = c.propose_reconfig(full ^ (1 << v1))
+    assert t1 is not None
+    _commit(c, t1)
+    t2 = c.propose_reconfig(full ^ (1 << v1) ^ (1 << v2))
+    assert t2 is not None
+    _commit(c, t2)
+    # Crash both learners AND one voter: 2 of 3 voters remain — a voter
+    # majority, but only 2 < 3 = full-k majority of live nodes.
+    voters = full ^ (1 << v1) ^ (1 << v2)
+    a = next(i for i in range(cfg.k) if (voters >> i) & 1 and i != old)
+    dead = {v1, v2, next(i for i in range(cfg.k)
+                         if (voters >> i) & 1 and i not in (old, a))}
+    c.alive_fn = lambda tk, dead=dead: [i not in dead for i in range(cfg.k)]
+    r = c.read(max_ticks=400)
+    assert r is not None, "read stalled in a healthy shrunk config"
+    read_index, served_index, digest = r
+    assert read_index >= t2[0]
+    assert digest == c.expected_digest(served_index)
 
 
 def test_read_requires_quorum_roundtrip():

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import jax
 import numpy as np
+import pytest
 
 from raft_tpu import parallel, sim
 from raft_tpu.config import RaftConfig
@@ -40,3 +41,17 @@ def test_sharded_state_actually_sharded():
     st = parallel.shard_state(sim.init(RaftConfig(), n_groups=64), mesh)
     shard_devs = {s.device for s in st.nodes.term.addressable_shards}
     assert len(shard_devs) == 8
+
+
+def test_make_mesh_refuses_silent_cpu_fallback():
+    """Asking for more devices than the platform has must raise unless
+    the caller opts into the CPU test vehicle (VERDICT round-4 item 6)."""
+    n_too_many = len(jax.devices()) + 1
+    with pytest.raises(ValueError):
+        parallel.make_mesh(n_too_many)
+    # With the flag, the request still raises here (the CPU platform
+    # itself has only 8 virtual devices) — but via the same explicit
+    # error, not a silent platform swap.
+    with pytest.raises(ValueError):
+        parallel.make_mesh(len(jax.devices("cpu")) + 1,
+                           allow_cpu_fallback=True)
