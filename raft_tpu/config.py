@@ -64,6 +64,14 @@ class RaftConfig:
     reconfig_epoch: int = 64
     min_voters: int = 0
 
+    # Leadership-transfer schedule (DESIGN.md §2d): at the first tick of
+    # each transfer epoch, w.p. transfer_prob, the leader hands
+    # leadership to a hash-chosen fully-caught-up voter by sending
+    # TimeoutNow (dissertation §3.10); the target campaigns immediately,
+    # bypassing PreVote. Off by default (statically absent).
+    transfer_prob: float = 0.0
+    transfer_epoch: int = 64
+
     # Scheduled linearizable reads (DESIGN.md §2c): every `read_every`
     # ticks the leader registers a ReadIndex read (dissertation §6.4) at
     # the start of phase C; it completes in a later tick's phase A once
@@ -121,6 +129,10 @@ class RaftConfig:
     @property
     def reconfig_u32(self) -> int:
         return _prob_to_u32(self.reconfig_prob)
+
+    @property
+    def transfer_u32(self) -> int:
+        return _prob_to_u32(self.transfer_prob)
 
     @property
     def drop_u32(self) -> int:

@@ -273,6 +273,8 @@ class Node:
                 self._on_pv_req(m)
             elif m.type == rpc.PV_RESP:
                 self._on_pv_resp(m)
+            elif m.type == rpc.TN_REQ:
+                self._on_tn_req(m)
 
     def _on_rv_req(self, m: rpc.RequestVoteReq):
         if m.term > self.term:
@@ -455,6 +457,25 @@ class Node:
         if self._vote_quorum():
             self._start_election()   # quorum would vote for us: go real
 
+    def _on_tn_req(self, m: rpc.TimeoutNow):
+        """Leadership transfer (dissertation §3.10): campaign NOW —
+        deliberately bypassing PreVote (the sender is the current
+        leader handing off; a pre-ballot would be refused under the
+        lease check everyone still holds for that leader).
+
+        Honored only as FOLLOWER or PRECANDIDATE: a CANDIDATE already
+        started an election — possibly THIS tick (a pre-ballot quorum in
+        phase D, processed before TN in the canonical order) — and a
+        second `_start_election` would emit two RequestVotes per
+        destination in one tick, violating the one-message-per-
+        (type, src, dst) contract the dense TPU mailbox relies on."""
+        if m.term > self.term:
+            self._step_down(m.term)
+        if (m.term < self.term or self.role in (LEADER, CANDIDATE)
+                or not self.is_voter()):
+            return
+        self._start_election()
+
     # ------------------------------------------------------------- client API
 
     def propose(self, payload: int):
@@ -552,6 +573,7 @@ class Node:
             if self.heartbeat_elapsed >= self.cfg.heartbeat_every:
                 self.heartbeat_elapsed = 0
                 self._broadcast_append()
+            self._maybe_transfer()
         else:
             self.leader_elapsed += 1
             self.election_elapsed += 1
@@ -563,6 +585,43 @@ class Node:
                     self._start_prevote()
                 else:
                     self._start_election()
+
+    def _send_timeout_now(self, target: int):
+        """Transfer gate: the target must be a CURRENT-config voter, not
+        self, hold every committed entry, and be the most-caught-up
+        peer (the dissertation's §3.10 "catch the target up first"
+        precondition, adapted to continuous appends — strict equality
+        with last_index can never hold while in-flight entries lead the
+        acks, so the gate asks for the best log a follower can have)."""
+        if target == self.id or not self.is_voter(target):
+            return None
+        mt = self.match_index[target]
+        if mt < self.commit or mt != max(self.match_index):
+            return None
+        self.transport.send(rpc.TimeoutNow(
+            rpc.TN_REQ, self.id, target, term=self.term))
+        return True
+
+    def transfer_leadership(self, target: int):
+        """Client API: hand leadership to `target` (dissertation §3.10).
+        Returns True if TimeoutNow was sent, None if not leader or the
+        gate refused (non-voter, self, or not caught up)."""
+        if self.role != LEADER:
+            return None
+        return self._send_timeout_now(target)
+
+    def _maybe_transfer(self):
+        """The deterministic transfer schedule (DESIGN.md §2d): at the
+        first tick of each transfer epoch, w.p. transfer_prob, hand
+        leadership to a hash-chosen peer — if the gate clears."""
+        cfg = self.cfg
+        if cfg.transfer_u32 == 0 or self.now % cfg.transfer_epoch != 0:
+            return
+        epoch = self.now // cfg.transfer_epoch
+        if not rng.transfer_fires(cfg.seed, self.g, epoch, cfg.transfer_u32):
+            return
+        self._send_timeout_now(
+            rng.transfer_target(cfg.seed, self.g, epoch, cfg.k))
 
     def _broadcast_append(self):
         for p in range(self.cfg.k):

@@ -14,7 +14,8 @@ from typing import List, Tuple
 # exactly this order. Pre-vote types come last so that enabling
 # `cfg.prevote` leaves the processing order of the original six
 # unchanged (prevote-off traces are bit-identical to older builds).
-RV_REQ, RV_RESP, AE_REQ, AE_RESP, IS_REQ, IS_RESP, PV_REQ, PV_RESP = range(8)
+(RV_REQ, RV_RESP, AE_REQ, AE_RESP, IS_REQ, IS_RESP, PV_REQ, PV_RESP,
+ TN_REQ) = range(9)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,6 +89,14 @@ class PreVoteResp(Msg):
     term: int = 0
     req_term: int = 0
     granted: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeoutNow(Msg):
+    """Leadership transfer (dissertation §3.10): the leader tells a
+    fully-caught-up voter to campaign immediately — bypassing PreVote,
+    since the handoff is deliberate. `term` is the sender's term."""
+    term: int = 0
 
 
 def inbox_sort_key(m: Msg):

@@ -57,14 +57,16 @@ def save(path, st: State, t: int, metrics: Optional[Metrics] = None,
 
 
 OPTIONAL_FIELDS = frozenset(
-    f for f in Mailbox._fields if f.startswith("pv_"))
+    f for f in Mailbox._fields
+    if Mailbox._field_defaults.get(f, "required") is None)
 
 
 def _load_nt(z, prefix: str, cls):
-    """Legitimately-optional fields (the prevote Mailbox slots, absent
-    when `cfg.prevote` is off — skipped by `_flatten` on save) load as
-    None; any OTHER missing field is a corrupt/incompatible checkpoint
-    and raises immediately, naming the field."""
+    """Legitimately-optional fields (the Mailbox slots whose NamedTuple
+    default is None — prevote/transfer, absent when their schedules are
+    off and skipped by `_flatten` on save) load as None; any OTHER
+    missing field is a corrupt/incompatible checkpoint and raises
+    immediately, naming the field."""
     def get(f):
         key = f"{prefix}{f}"
         if key not in z.files:

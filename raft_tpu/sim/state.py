@@ -136,6 +136,11 @@ class Mailbox(NamedTuple):
     pv_resp_req_term: jnp.ndarray | None = None  # i32 — echoed proposal
     pv_resp_granted: jnp.ndarray | None = None  # bool
 
+    # TimeoutNow (leadership transfer, DESIGN.md §2d) — present only
+    # when the transfer schedule is statically on.
+    tn_present: jnp.ndarray | None = None       # bool
+    tn_term: jnp.ndarray | None = None          # i32
+
 
 class State(NamedTuple):
     nodes: PerNode        # leaves [G, K, ...]
@@ -148,11 +153,12 @@ class State(NamedTuple):
     # [0, G_local), silently duplicating universes.
 
 
-def empty_mailbox(lead_shape: tuple, prevote: bool = False) -> Mailbox:
+def empty_mailbox(lead_shape: tuple, prevote: bool = False,
+                  transfer: bool = False) -> Mailbox:
     """Zero mailbox with the given leading shape: `(g, k, k)` for the
     in-flight buffer ([G, dst, src]), `(k,)` for a per-node outbox inside
-    the vmapped step. PreVote slots are materialized only when
-    `prevote`."""
+    the vmapped step. PreVote / TimeoutNow slots are materialized only
+    when their schedules are on."""
     def z(dtype, *extra):
         return jnp.zeros(tuple(lead_shape) + extra, dtype)
 
@@ -162,6 +168,8 @@ def empty_mailbox(lead_shape: tuple, prevote: bool = False) -> Mailbox:
                   pv_req_lli=z(I32), pv_req_llt=z(I32),
                   pv_resp_present=z(BOOL), pv_resp_term=z(I32),
                   pv_resp_req_term=z(I32), pv_resp_granted=z(BOOL))
+    if transfer:
+        pv.update(tn_present=z(BOOL), tn_term=z(I32))
     return Mailbox(
         rv_req_present=z(BOOL), rv_req_term=z(I32), rv_req_lli=z(I32),
         rv_req_llt=z(I32),
@@ -218,7 +226,8 @@ def init(cfg: RaftConfig, n_groups: int | None = None) -> State:
     )
     return State(
         nodes=nodes,
-        mailbox=empty_mailbox((g, k, k), cfg.prevote),
+        mailbox=empty_mailbox((g, k, k), cfg.prevote,
+                              cfg.transfer_u32 != 0),
         alive_prev=jnp.ones((g, k), BOOL),
         group_id=jnp.arange(g, dtype=I32),
     )

@@ -519,17 +519,38 @@ def _on_pv_resp(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
     return _start_election_masked(cfg, ns, out, g, i, won_pre)
 
 
+def _on_tn_req(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
+    """`Node._on_tn_req`: TimeoutNow — campaign immediately, bypassing
+    PreVote (the handoff is deliberate; see node.py)."""
+    if not cfg.transfer_u32:
+        return ns, out
+    present = ib.tn_present[src]
+    m_term = ib.tn_term[src]
+    ns = _step_down(cfg, ns, m_term, present & (m_term > ns.term))
+    # FOLLOWER/PRECANDIDATE only (node.py): a CANDIDATE already
+    # campaigned — possibly this very tick via a pre-ballot quorum — and
+    # a second start would double-write the per-(type,src,dst) RV slot.
+    cond = (present & (m_term == ns.term)
+            & (ns.role != LEADER) & (ns.role != CANDIDATE))
+    if cfg.reconfig_u32:
+        voters, _ = _current_config(cfg, ns)
+        cond = cond & (((voters >> i) & 1) == 1)
+    return _start_election_masked(cfg, ns, out, g, i, cond)
+
+
 _HANDLERS = (_on_rv_req, _on_rv_resp, _on_ae_req, _on_ae_resp,
-             _on_is_req, _on_is_resp, _on_pv_req, _on_pv_resp)
-#             canonical rpc type order (PV last — rpc.py)
+             _on_is_req, _on_is_resp, _on_pv_req, _on_pv_resp, _on_tn_req)
+#             canonical rpc type order (PV/TN last — rpc.py)
 
 
 # ----------------------------------------------------------------- phase T
 
 
-def _phase_t(cfg, ns, out, g, i):
+def _phase_t(cfg, ns, out, g, i, t):
     """`Node.phase_t` (node.py:316) + `_broadcast_append` (node.py:327)
-    + `_start_election` (node.py:122)."""
+    + `_start_election` (node.py:122) + the scheduled leadership
+    transfer (node.py `_maybe_transfer`). `t` is the absolute tick (the
+    transfer schedule hashes it)."""
     is_leader = ns.role == LEADER
     hb = ns.heartbeat_elapsed + 1
     fire = is_leader & (hb >= cfg.heartbeat_every)
@@ -567,6 +588,32 @@ def _phase_t(cfg, ns, out, g, i):
             ae_req_n=_put(out.ae_req_n, p, use_ae, n),
             ae_req_commit=_put(out.ae_req_commit, p, use_ae, ns.commit),
         )
+
+    if cfg.transfer_u32:
+        # `Node._maybe_transfer` (DESIGN.md §2d): first tick of a firing
+        # epoch, hash-chosen target, gated on current-config voter +
+        # fully caught up. The destination is traced, so the send is a
+        # K-unrolled one-hot write.
+        epoch = t // cfg.transfer_epoch
+        attempts = (is_leader & ((t % cfg.transfer_epoch) == 0)
+                    & jrng.transfer_fires(cfg.seed, g, epoch,
+                                          cfg.transfer_u32))
+        target = jrng.transfer_target(cfg.seed, g, epoch, cfg.k)
+        # Gate (node.py _send_timeout_now): most-caught-up peer holding
+        # every committed entry. The self slot of match_index is always
+        # 0, so the max ranges over peers only.
+        mt = _lget(ns.match_index, target)
+        caught_up = (mt >= ns.commit) & (mt == jnp.max(ns.match_index, -1))
+        ok = attempts & caught_up & (target != i)
+        if cfg.reconfig_u32:
+            votersT, _ = _current_config(cfg, ns)
+            ok = ok & (((votersT >> target) & 1) == 1)
+        for p in range(cfg.k):
+            send = ok & (target == p)
+            out = out._replace(
+                tn_present=_put(out.tn_present, p, send, True),
+                tn_term=_put(out.tn_term, p, send, ns.term),
+            )
 
     # Election timeout (non-leaders; non-voters never campaign —
     # node.py phase_t's is_voter gate). With reconfig statically off,
@@ -764,13 +811,13 @@ def _node_tick(cfg, t, ns: PerNode, inbox: Mailbox, g, i, glog_t, glog_p):
     15.4 ms/tick at 100K groups, 5x the compile time): [G]-shaped ops
     lose more to per-op overhead and lost cross-node fusion than the
     skipped fifth of phase D saves. Keep the [G, K] double-vmap."""
-    out = empty_mailbox((cfg.k,), cfg.prevote)
+    out = empty_mailbox((cfg.k,), cfg.prevote, cfg.transfer_u32 != 0)
     gl = (glog_t, glog_p, t)   # phase-D context: group logs + the clock
     # Phase D: canonical (type, src) order — node.py:154 + rpc.sort_inbox.
     for handler in _HANDLERS:
         for src in range(cfg.k):
             ns, out = handler(cfg, ns, out, g, i, src, inbox, gl)
-    ns, out = _phase_t(cfg, ns, out, g, i)
+    ns, out = _phase_t(cfg, ns, out, g, i, t)
     ns = _phase_c(cfg, ns, g, t)
     ns = _phase_a(cfg, ns, i)
     return ns, out
@@ -823,6 +870,8 @@ def _filter_mailbox(cfg, mb: Mailbox, t, alive_now, group_id) -> Mailbox:
     if mb.pv_req_present is not None:
         pv = dict(pv_req_present=mb.pv_req_present & keep,
                   pv_resp_present=mb.pv_resp_present & keep)
+    if mb.tn_present is not None:
+        pv["tn_present"] = mb.tn_present & keep
     return mb._replace(
         rv_req_present=mb.rv_req_present & keep,
         rv_resp_present=mb.rv_resp_present & keep,
@@ -874,6 +923,8 @@ def tick(cfg: RaftConfig, st: State, t) -> State:
     if outbox.pv_req_present is not None:
         pv = dict(pv_req_present=outbox.pv_req_present & src_alive,
                   pv_resp_present=outbox.pv_resp_present & src_alive)
+    if outbox.tn_present is not None:
+        pv["tn_present"] = outbox.tn_present & src_alive
     outbox = outbox._replace(
         rv_req_present=outbox.rv_req_present & src_alive,
         rv_resp_present=outbox.rv_resp_present & src_alive,

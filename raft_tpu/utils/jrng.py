@@ -89,5 +89,16 @@ def reconfig_target(seed, g, epoch, k: int):
             % jnp.uint32(k)).astype(jnp.int32)
 
 
+def transfer_fires(seed, g, epoch, transfer_u32: int):
+    if transfer_u32 == 0:
+        return jnp.zeros(_full_shape(g, epoch), jnp.bool_)
+    return hash_u32(seed, _r.TAG_TRANSFER, g, epoch) < jnp.uint32(transfer_u32)
+
+
+def transfer_target(seed, g, epoch, k: int):
+    return (hash_u32(seed, _r.TAG_TRANSFER_NODE, g, epoch)
+            % jnp.uint32(k)).astype(jnp.int32)
+
+
 def digest_update(digest, index, payload):
     return mix32(_u32(digest) * _GOLD + mix32(_u32(index) * _GOLD + _u32(payload)))

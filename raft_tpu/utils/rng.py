@@ -26,6 +26,8 @@ TAG_PART_SIDE = 5  # per-node partition side assignment
 TAG_CMD = 6       # client command payloads
 TAG_RECONFIG = 7       # per-group per-epoch membership-change proposal?
 TAG_RECONFIG_NODE = 8  # which node's membership the proposal toggles
+TAG_TRANSFER = 9       # per-group per-epoch leadership-transfer attempt?
+TAG_TRANSFER_NODE = 10  # which node the transfer hands leadership to
 
 
 def mix32(x: int) -> int:
@@ -90,6 +92,16 @@ def reconfig_fires(seed: int, g: int, epoch: int, reconfig_u32: int) -> bool:
 def reconfig_target(seed: int, g: int, epoch: int, k: int) -> int:
     """Which node's membership the epoch's proposal toggles."""
     return hash_u32(seed, TAG_RECONFIG_NODE, g, epoch) % k
+
+
+def transfer_fires(seed: int, g: int, epoch: int, transfer_u32: int) -> bool:
+    """Does the leadership-transfer schedule attempt at this epoch?"""
+    return hash_u32(seed, TAG_TRANSFER, g, epoch) < transfer_u32
+
+
+def transfer_target(seed: int, g: int, epoch: int, k: int) -> int:
+    """Which node the epoch's transfer attempt hands leadership to."""
+    return hash_u32(seed, TAG_TRANSFER_NODE, g, epoch) % k
 
 
 def digest_update(digest: int, index: int, payload: int) -> int:

@@ -104,7 +104,7 @@ def apply_variant(name: str) -> str:
         step_mod._phase_a = thin_apply
         return "full"
     if name == "nophaseT":
-        step_mod._phase_t = lambda cfg, ns, out, g, i: (ns, out)
+        step_mod._phase_t = lambda cfg, ns, out, g, i, t: (ns, out)
         return "full"
     if name == "nophaseC":
         step_mod._phase_c = lambda cfg, ns, g, t: ns

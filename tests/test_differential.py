@@ -173,6 +173,30 @@ def test_differential_reads_with_reconfig():
     assert int(np.asarray(jx["reads_done"]).max()) > 0
 
 
+def test_differential_transfer():
+    """Leadership-transfer universe (DESIGN.md §2d): the scheduled
+    TimeoutNow handoffs — combined with PreVote, whose lease the
+    transfer must bypass — stay bit-identical across backends."""
+    cfg = RaftConfig(seed=59, transfer_prob=0.8, transfer_epoch=48,
+                     prevote=True, crash_prob=0.15, crash_epoch=64,
+                     drop_prob=0.03)
+    clusters, _ = run_lockstep(cfg, n_groups=4, ticks=500)
+    # Transfers actually moved leadership (terms advanced well past the
+    # initial election) and the groups kept committing.
+    assert all(max(n.term for n in c.nodes) > 2 for c in clusters)
+    assert all(max(n.commit for n in c.nodes) > 10 for c in clusters)
+
+
+def test_differential_transfer_reconfig():
+    """Transfer x membership change: the TimeoutNow voter gate (both
+    the sender's target check and the receiver's campaign check) must
+    track the churning config identically on both backends."""
+    cfg = RaftConfig(seed=61, transfer_prob=0.8, transfer_epoch=48,
+                     reconfig_prob=0.8, reconfig_epoch=40,
+                     crash_prob=0.15, crash_epoch=64)
+    run_lockstep(cfg, n_groups=2, ticks=500)
+
+
 def test_comparator_has_teeth():
     """Prove the gate detects a single-field single-node single-tick drift:
     corrupt one sim trace cell by one and require a loud failure."""

@@ -1,0 +1,118 @@
+"""Leadership transfer (dissertation §3.10) on the CPU oracle: the
+client API hands leadership to a caught-up voter in one election round;
+the gate refuses bad targets; transfer works with PreVote on (TimeoutNow
+bypasses the pre-ballot). Batched-path parity is pinned by
+tests/test_differential.py::test_differential_transfer."""
+
+from __future__ import annotations
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.cluster import Cluster
+from raft_tpu.core.node import LEADER
+
+
+def _elect(c: Cluster, max_ticks: int = 300) -> int:
+    for _ in range(max_ticks):
+        if c.leader() is not None:
+            return c.leader()
+        c.tick()
+    raise AssertionError("no leader elected")
+
+
+def _settle_and_pick_target(c: Cluster):
+    lead = _elect(c)
+    c.run(30)   # let replication catch everyone up
+    lead = c.leader()
+    target = (lead + 1) % c.cfg.k
+    return lead, target
+
+
+def test_transfer_moves_leadership_to_target():
+    c = Cluster(RaftConfig(seed=90))
+    lead, target = _settle_and_pick_target(c)
+    assert c.nodes[lead].transfer_leadership(target) is True
+    for _ in range(30):
+        c.tick()
+        if c.leader() == target:
+            break
+    assert c.leader() == target
+    # The new regime commits.
+    before = max(n.commit for n in c.nodes)
+    c.run(20)
+    assert max(n.commit for n in c.nodes) > before
+
+
+def test_transfer_gate_refusals():
+    c = Cluster(RaftConfig(seed=91))
+    lead, target = _settle_and_pick_target(c)
+    n = c.nodes[lead]
+    assert n.transfer_leadership(lead) is None          # self
+    # A follower can't initiate.
+    assert c.nodes[target].transfer_leadership(lead) is None
+    # A lagging target is refused: fake a stale match_index.
+    n.match_index[target] = 0
+    assert n.transfer_leadership(target) is None
+
+
+def test_transfer_refuses_non_voter_target():
+    c = Cluster(RaftConfig(seed=92))
+    lead, victim = _settle_and_pick_target(c)
+    full = (1 << c.cfg.k) - 1
+    t = c.propose_reconfig(full ^ (1 << victim))
+    assert t is not None
+    for _ in range(100):
+        if c.is_committed(t):
+            break
+        c.tick()
+    assert c.is_committed(t)
+    lead = c.leader()
+    assert c.nodes[lead].transfer_leadership(victim) is None
+
+
+def test_transfer_bypasses_prevote():
+    """With PreVote on, every peer holds a fresh lease for the current
+    leader, so an ordinary campaign by the target would be refused —
+    TimeoutNow must bypass the pre-ballot and still win."""
+    c = Cluster(RaftConfig(seed=93, prevote=True))
+    lead, target = _settle_and_pick_target(c)
+    assert c.nodes[target].leader_elapsed < c.cfg.election_min
+    assert c.nodes[lead].transfer_leadership(target) is True
+    for _ in range(30):
+        c.tick()
+        if c.leader() == target:
+            break
+    assert c.leader() == target
+
+
+def test_timeout_now_ignored_by_candidate():
+    """A CANDIDATE already started an election (possibly this tick, via
+    a pre-ballot quorum processed earlier in phase D) — TimeoutNow must
+    not start a second one, or two RequestVotes per destination would
+    share one tick (the dense-mailbox contract violation)."""
+    from raft_tpu.core import rpc
+    from raft_tpu.core.node import CANDIDATE
+
+    c = Cluster(RaftConfig(seed=95))
+    lead, target = _settle_and_pick_target(c)
+    n = c.nodes[target]
+    n.term += 1
+    n.role = CANDIDATE
+    term_before = n.term
+    sent_before = len(c.transport._outbox)
+    n._on_tn_req(rpc.TimeoutNow(rpc.TN_REQ, src=lead, dst=target,
+                                term=term_before))
+    assert n.term == term_before and n.role == CANDIDATE
+    assert len(c.transport._outbox) == sent_before   # no second broadcast
+
+
+def test_scheduled_transfer_universe_safe_and_live():
+    """The deterministic schedule churns leadership; safety checkers
+    stay silent and the group keeps committing."""
+    cfg = RaftConfig(seed=94, transfer_prob=0.9, transfer_epoch=32)
+    c = Cluster(cfg)
+    c.run(600)
+    assert max(n.commit for n in c.nodes) > 300
+    # Leadership actually moved at least once: more than one node has
+    # ever been leader (terms advanced beyond the first election).
+    assert max(n.term for n in c.nodes) > 1, (
+        "transfer schedule never moved leadership — test is vacuous")
