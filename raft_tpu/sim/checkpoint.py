@@ -77,13 +77,20 @@ def _load_nt(z, prefix: str, cls):
     return cls(**{f: get(f) for f in cls._fields})
 
 
-def load(path, cfg: Optional[RaftConfig] = None
+def load(path, cfg: Optional[RaftConfig] = None, sharding=None
          ) -> Tuple[State, int, Optional[Metrics]]:
     """Read (state, tick, metrics-or-None) from `path`.
 
     If `cfg` is given and the checkpoint embeds one, they must match
     exactly — resuming a deterministic universe under different semantic
-    knobs is always a bug."""
+    knobs is always a bug.
+
+    Pass `sharding` (a `NamedSharding`, e.g. `parallel.state_sharding
+    (mesh)`) to place the state directly onto a device mesh — the
+    elastic-recovery path: a checkpoint written by an n-device run
+    resumes on an m-device mesh of any divisor of G, because the npz is
+    device-layout-free and `State.group_id` travels with the shard
+    (`tests/test_checkpoint.py::test_resume_onto_different_mesh`)."""
     with np.load(path) as z:
         version = int(z["__version__"])
         if version != _VERSION:
@@ -107,4 +114,7 @@ def load(path, cfg: Optional[RaftConfig] = None
         if "metrics.committed" in z.files:
             metrics = Metrics(**{f: jnp.asarray(z[f"metrics.{f}"])
                                  for f in Metrics._fields})
+    if sharding is not None:
+        import jax
+        st = jax.device_put(st, sharding)
     return st, t, metrics

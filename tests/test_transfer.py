@@ -20,7 +20,7 @@ def _elect(c: Cluster, max_ticks: int = 300) -> int:
 
 
 def _settle_and_pick_target(c: Cluster):
-    lead = _elect(c)
+    _elect(c)
     c.run(30)   # let replication catch everyone up
     lead = c.leader()
     target = (lead + 1) % c.cfg.k
