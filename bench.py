@@ -41,16 +41,18 @@ def log(msg: str):
 CHUNK = 200   # ticks per device call: one compiled program, reused
 
 
-def bench_throughput(n_groups: int, ticks: int, warmup_chunks: int = 1):
-    """Config 2/3/5 shape: steady-state replication throughput.
+def _timed_chunks(cfg, n_groups: int, ticks: int, counter_fn,
+                  warmup_chunks: int = 1):
+    """Shared warmup + chunked-timing harness for every counter-delta
+    bench segment. Runs in fixed-size chunks so every timed device call
+    reuses the one compiled (cfg, CHUNK, pytree-shape) program — the
+    warmup chunk absorbs compilation AND the initial elections, so the
+    timed region measures steady state only. (Chunking also keeps
+    single device programs short, which the TPU tunnel tolerates far
+    better than one scan over 10^3+ ticks.)
 
-    Runs in fixed-size chunks so every timed device call reuses the one
-    compiled (cfg, CHUNK, pytree-shape) program — the warmup chunk absorbs
-    compilation AND the initial elections, so the timed region measures
-    steady-state consensus only. (Chunking also keeps single device
-    programs short, which the TPU tunnel tolerates far better than one
-    scan over 10^3+ ticks.)"""
-    cfg = RaftConfig(seed=42)
+    `counter_fn(st, m) -> int` must read a monotone event counter;
+    returns (rate/s, delta, elapsed_s, timed_ticks)."""
     st = sim.init(cfg, n_groups=n_groups)
     m = metrics_init(n_groups)
     t0 = time.perf_counter()
@@ -61,8 +63,7 @@ def bench_throughput(n_groups: int, ticks: int, warmup_chunks: int = 1):
     jax.block_until_ready(st)
     log(f"  warmup {tick_at} ticks (incl. compile): "
         f"{time.perf_counter() - t0:.1f}s")
-    base = total_rounds(m)
-
+    base = counter_fn(st, m)
     n_chunks = max(1, ticks // CHUNK)
     start = time.perf_counter()
     for _ in range(n_chunks):
@@ -70,9 +71,15 @@ def bench_throughput(n_groups: int, ticks: int, warmup_chunks: int = 1):
         tick_at += CHUNK
     jax.block_until_ready(st)
     elapsed = time.perf_counter() - start
-    timed_ticks = n_chunks * CHUNK
-    rounds = total_rounds(m) - base
-    rps = rounds / elapsed
+    delta = counter_fn(st, m) - base
+    return delta / elapsed, delta, elapsed, n_chunks * CHUNK
+
+
+def bench_throughput(n_groups: int, ticks: int):
+    """Config 2/3/5 shape: steady-state replication throughput."""
+    cfg = RaftConfig(seed=42)
+    rps, rounds, elapsed, timed_ticks = _timed_chunks(
+        cfg, n_groups, ticks, lambda st, m: total_rounds(m))
     log(f"  {n_groups} groups x {timed_ticks} ticks: {rounds} rounds in "
         f"{elapsed:.2f}s -> {rps:,.0f} rounds/s "
         f"({timed_ticks / elapsed:,.0f} ticks/s)")
@@ -108,7 +115,7 @@ def bench_elections(n_groups: int, ticks: int):
     return p50, p99, int(m.elections), censored, max_lat, p99_note
 
 
-def bench_election_rounds(n_groups: int, ticks: int, warmup_chunks: int = 1):
+def bench_election_rounds(n_groups: int, ticks: int):
     """Config 2 shape: pure leader-election rounds — no client commands
     (`cmds_per_tick=0`, so no AppendEntries payload traffic and commits
     stay 0), with constant crash churn so elections keep completing.
@@ -128,53 +135,25 @@ def bench_election_rounds(n_groups: int, ticks: int, warmup_chunks: int = 1):
     election count so under-sampling is visible)."""
     cfg = RaftConfig(seed=44, cmds_per_tick=0, crash_prob=0.5,
                      crash_epoch=32)
-    st = sim.init(cfg, n_groups=n_groups)
-    m = metrics_init(n_groups)
-    tick_at = 0
-    for _ in range(warmup_chunks):
-        st, m = sim.run(cfg, st, CHUNK, tick_at, m)
-        tick_at += CHUNK
-    jax.block_until_ready(st)
-    base = int(m.elections)
-    n_chunks = max(1, ticks // CHUNK)
-    start = time.perf_counter()
-    for _ in range(n_chunks):
-        st, m = sim.run(cfg, st, CHUNK, tick_at, m)
-        tick_at += CHUNK
-    jax.block_until_ready(st)
-    elapsed = time.perf_counter() - start
-    elections = int(m.elections) - base
-    eps = elections / elapsed
-    log(f"  election rounds {n_groups} groups x {n_chunks * CHUNK} ticks: "
+    eps, elections, elapsed, timed_ticks = _timed_chunks(
+        cfg, n_groups, ticks, lambda st, m: int(m.elections))
+    log(f"  election rounds {n_groups} groups x {timed_ticks} ticks: "
         f"{elections} elections in {elapsed:.2f}s -> {eps:,.0f} elections/s")
     return eps, elections
 
 
-def bench_reads(n_groups: int, ticks: int, warmup_chunks: int = 1):
+def bench_reads(n_groups: int, ticks: int):
     """Scheduled linearizable reads at scale (DESIGN.md §2c): the
     config-5 replication workload with the ReadIndex pipeline on
     (read_every=4). Completed reads are counted from the `reads_done`
     trace field — with no fault schedule the counter is monotone (no
     restarts zero it), so the timed delta is exact."""
     cfg = RaftConfig(seed=45, read_every=4)
-    st = sim.init(cfg, n_groups=n_groups)
-    m = metrics_init(n_groups)
-    tick_at = 0
-    for _ in range(warmup_chunks):
-        st, m = sim.run(cfg, st, CHUNK, tick_at, m)
-        tick_at += CHUNK
-    jax.block_until_ready(st)
-    base = int(np.asarray(st.nodes.reads_done).astype(np.int64).sum())
-    n_chunks = max(1, ticks // CHUNK)
-    start = time.perf_counter()
-    for _ in range(n_chunks):
-        st, m = sim.run(cfg, st, CHUNK, tick_at, m)
-        tick_at += CHUNK
-    jax.block_until_ready(st)
-    elapsed = time.perf_counter() - start
-    reads = int(np.asarray(st.nodes.reads_done).astype(np.int64).sum()) - base
-    rps = reads / elapsed
-    log(f"  linearizable reads {n_groups} groups x {n_chunks * CHUNK} "
+    rps, reads, elapsed, timed_ticks = _timed_chunks(
+        cfg, n_groups, ticks,
+        lambda st, m: int(np.asarray(st.nodes.reads_done)
+                          .astype(np.int64).sum()))
+    log(f"  linearizable reads {n_groups} groups x {timed_ticks} "
         f"ticks (read_every={cfg.read_every}): {reads} reads in "
         f"{elapsed:.2f}s -> {rps:,.0f} reads/s")
     return rps, reads

@@ -19,6 +19,7 @@ import dataclasses
 import json
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -115,6 +116,5 @@ def load(path, cfg: Optional[RaftConfig] = None, sharding=None
             metrics = Metrics(**{f: jnp.asarray(z[f"metrics.{f}"])
                                  for f in Metrics._fields})
     if sharding is not None:
-        import jax
         st = jax.device_put(st, sharding)
     return st, t, metrics
