@@ -52,7 +52,9 @@ def _timed_chunks(cfg, n_groups: int, ticks: int, counter_fn,
     better than one scan over 10^3+ ticks.)
 
     `counter_fn(st, m) -> int` must read a monotone event counter;
-    returns (rate/s, delta, elapsed_s, timed_ticks)."""
+    returns (rate/s, delta, elapsed_s, timed_ticks, st, m) — the final
+    state/metrics let a caller extend the same universe without
+    re-simulating it from tick 0."""
     st = sim.init(cfg, n_groups=n_groups)
     m = metrics_init(n_groups)
     t0 = time.perf_counter()
@@ -72,18 +74,83 @@ def _timed_chunks(cfg, n_groups: int, ticks: int, counter_fn,
     jax.block_until_ready(st)
     elapsed = time.perf_counter() - start
     delta = counter_fn(st, m) - base
-    return delta / elapsed, delta, elapsed, n_chunks * CHUNK
+    return delta / elapsed, delta, elapsed, n_chunks * CHUNK, st, m
 
 
 def bench_throughput(n_groups: int, ticks: int):
-    """Config 2/3/5 shape: steady-state replication throughput."""
+    """Config 2/3/5 shape: steady-state replication throughput.
+
+    Runs BOTH engines at the same tick count — the XLA scan path
+    (sim.run) and the Pallas fused-chunk kernel (sim.pkernel), which
+    keeps a block's whole state VMEM-resident across a 200-tick chunk
+    instead of streaming ~18 GB/tick of [G,K,L] intermediates through
+    HBM (DESIGN.md §7). The kernel's number is promoted to the headline
+    ONLY if its per-group committed vector is bit-identical to the XLA
+    run at the same tick — a full-shape in-run differential on top of
+    the CPU-interpret gate in tests/test_pkernel.py. On any mismatch or
+    kernel failure the XLA number stands and the JSON says so."""
     cfg = RaftConfig(seed=42)
-    rps, rounds, elapsed, timed_ticks = _timed_chunks(
+    rps, rounds, elapsed, timed_ticks, st_ref, m_ref = _timed_chunks(
         cfg, n_groups, ticks, lambda st, m: total_rounds(m))
-    log(f"  {n_groups} groups x {timed_ticks} ticks: {rounds} rounds in "
-        f"{elapsed:.2f}s -> {rps:,.0f} rounds/s "
+    log(f"  [xla] {n_groups} groups x {timed_ticks} ticks: {rounds} rounds "
+        f"in {elapsed:.2f}s -> {rps:,.0f} rounds/s "
         f"({timed_ticks / elapsed:,.0f} ticks/s)")
-    return rps, rounds, elapsed, timed_ticks
+    engine = "xla-scan"
+    pallas_rps = None
+
+    try:   # kernel failure of ANY kind (incl. import) never kills the bench
+        from raft_tpu.sim import pkernel
+        if pkernel.supported(cfg) and jax.devices()[0].platform == "tpu":
+            # TWO warmup launches: the first compiles for kinit's
+            # buffer layouts, the second for the kernel's own output
+            # layouts (a distinct executable — timing it once cost 13.5s
+            # of "steady state"). The timed region then measures only
+            # real launches, closed by the counter fetch itself (the
+            # tunnel's block_until_ready is not a reliable barrier).
+            leaves, g = pkernel.kinit(cfg, sim.init(cfg, n_groups=n_groups))
+            t0 = time.perf_counter()
+            leaves = pkernel.kstep(cfg, leaves, 0, CHUNK)
+            base = pkernel.kcommitted(leaves, g)            # forces #1
+            leaves = pkernel.kstep(cfg, leaves, CHUNK, CHUNK)
+            base = pkernel.kcommitted(leaves, g)            # forces #2
+            log(f"  [pallas] warmup {2 * CHUNK} ticks (incl. 2 compiles): "
+                f"{time.perf_counter() - t0:.1f}s")
+            n_chunks = timed_ticks // CHUNK
+            start = time.perf_counter()
+            for c in range(n_chunks):
+                leaves = pkernel.kstep(cfg, leaves, (c + 2) * CHUNK, CHUNK)
+            p_end = pkernel.kcommitted(leaves, g)   # fetch closes the timer
+            p_elapsed = time.perf_counter() - start
+            p_rounds = p_end - base
+            pallas_rps = p_rounds / p_elapsed
+            log(f"  [pallas] {n_groups} groups x {timed_ticks} ticks: "
+                f"{p_rounds} rounds in {p_elapsed:.2f}s -> "
+                f"{pallas_rps:,.0f} rounds/s "
+                f"({timed_ticks / p_elapsed:,.0f} ticks/s)")
+            # Differential: the same universe on the XLA path to the
+            # same tick, reusing _timed_chunks' final state (already at
+            # tick CHUNK + timed_ticks) — one more chunk reaches the
+            # kernel's 2*CHUNK + timed_ticks endpoint.
+            st_ref, m_ref = sim.run(cfg, st_ref, CHUNK,
+                                    CHUNK + timed_ticks, m_ref)
+            _, m_pal = pkernel.kfinish(cfg, leaves, g)
+            if np.array_equal(np.asarray(m_ref.committed),
+                              np.asarray(m_pal.committed)):
+                if pallas_rps > rps:
+                    rps, rounds, elapsed = pallas_rps, p_rounds, p_elapsed
+                    engine = "pallas-fused-chunk"
+                log("  [pallas] differential vs xla at same tick: "
+                    "bit-identical committed vector")
+            else:
+                log("  [pallas] DIFFERENTIAL MISMATCH - kernel number "
+                    "discarded, xla headline stands")
+                engine = "xla-scan (pallas mismatch!)"
+                pallas_rps = None   # never report a rate that failed it
+    except Exception as e:
+        pallas_rps = None           # a rate that never passed the differential
+        log(f"  [pallas] failed ({type(e).__name__}: {e}); "
+            f"xla headline stands")
+    return rps, rounds, elapsed, timed_ticks, engine, pallas_rps
 
 
 def bench_elections(n_groups: int, ticks: int):
@@ -135,7 +202,7 @@ def bench_election_rounds(n_groups: int, ticks: int):
     election count so under-sampling is visible)."""
     cfg = RaftConfig(seed=44, cmds_per_tick=0, crash_prob=0.5,
                      crash_epoch=32)
-    eps, elections, elapsed, timed_ticks = _timed_chunks(
+    eps, elections, elapsed, timed_ticks, _, _ = _timed_chunks(
         cfg, n_groups, ticks, lambda st, m: int(m.elections))
     log(f"  election rounds {n_groups} groups x {timed_ticks} ticks: "
         f"{elections} elections in {elapsed:.2f}s -> {eps:,.0f} elections/s")
@@ -149,7 +216,7 @@ def bench_reads(n_groups: int, ticks: int):
     trace field — with no fault schedule the counter is monotone (no
     restarts zero it), so the timed delta is exact."""
     cfg = RaftConfig(seed=45, read_every=4)
-    rps, reads, elapsed, timed_ticks = _timed_chunks(
+    rps, reads, elapsed, timed_ticks, _, _ = _timed_chunks(
         cfg, n_groups, ticks,
         lambda st, m: int(np.asarray(st.nodes.reads_done)
                           .astype(np.int64).sum()))
@@ -189,7 +256,8 @@ def main():
         rd_groups, rd_ticks = 50_000, 600   # ReadIndex-at-scale segment
 
     log(f"throughput (config-5 shape, {groups} x 5-node groups):")
-    rps, rounds, elapsed, ticks = bench_throughput(groups, ticks)
+    rps, rounds, elapsed, ticks, engine, pallas_rps = bench_throughput(
+        groups, ticks)
     log("election latency (config-4 shape):")
     p50, p99, n_elections, censored, max_lat, p99_note = bench_elections(
         e_groups, e_ticks)
@@ -206,6 +274,9 @@ def main():
         "n_groups": groups,
         "ticks": ticks,
         "wall_s": round(elapsed, 3),
+        "engine": engine,
+        "pallas_rounds_per_sec": (round(pallas_rps, 1)
+                                  if pallas_rps is not None else None),
         "p50_election_latency_ticks": p50,
         "p99_election_latency_ticks": p99,
         "p99_censored": censored,

@@ -1,0 +1,976 @@
+"""Pallas fused-chunk tick: many ticks per kernel launch, VMEM-resident.
+
+DESIGN.md §7 measured the XLA tick HBM-bound: ~13 GFLOP but ~18 GB of
+HBM traffic per tick at 100K groups, because every pass over the
+[G, K, L] / [G, K, K] state re-materializes intermediates in HBM. Raft
+groups never talk to each other (sim/state.py), so a *block* of groups
+can run an arbitrary number of ticks entirely out of VMEM: this module
+loads a block's full state once, runs a `lax.fori_loop` of whole ticks
+over values held in vector registers/VMEM, and writes the block back
+once. HBM traffic drops from O(ticks) full-state passes to one read +
+one write per chunk, turning the simulation compute-bound.
+
+Semantics are the SAME tick as `sim/step.py` — each helper here is a
+line-for-line port of its namesake — restricted to the statically-
+specialized feature subset of the headline benchmark: the reconfig /
+prevote / transfer / scheduled-read schedules all OFF (`supported()`),
+which is exactly the program step.py's static fast paths compile for
+that config. Crash / partition / drop faults ARE supported (they are in
+the headline config). Callers use the XLA path for anything else;
+`tests/test_pkernel.py` holds the two paths bit-identical on full State
+pytrees and metrics across fault mixes.
+
+Layout ("k-state"): the group axis folds into full vector registers: G
+groups become a trailing [GS, 128] (sublane x lane) pair with
+GS = G/128, so a per-node "scalar" is an [8, 128] tile inside the
+kernel and every VPU op runs at full vreg utilization. (A first version
+kept scalars as [1, G_blk] rows; it compiled and matched bit-exactly
+but idled 7/8 sublanes and LOST to the XLA path — 50 vs 79 ticks/s at
+100K.) Wire format per State leaf, the grid cutting 8-wide slices of
+the GS axis:
+
+  per-node scalar [G, K]    -> [K, GS, 128]      (per-node [8, 128])
+  peer vector     [G, K, K] -> [K, K, GS, 128]   (per-node [K, 8, 128])
+  log ring        [G, K, L] -> [K, L, GS, 128]   (per-node [L, 8, 128])
+  mailbox         [G, d, s] -> [d, s, GS, 128]   (per-node [K_src, 8, 128])
+
+Inside the kernel the per-node step is `jax.vmap`-ped over the node
+axis, exactly like step.py's inner vmap; reductions step.py takes over
+a trailing L/K axis happen over axis 0 here. Dynamic indexing stays
+one-hot compare+select (Mosaic has no scatter lowering, and the XLA
+path measured the same choice fastest).
+
+Mosaic/LLO lowering rules learned the hard way (each cost a compile
+failure; tests/test_pkernel.py guards them):
+- no select against a scalar bool constant (i8->i1 trunci): bool
+  updates use and/or masking (`_put`, freeze);
+- no vector i1 CONSTANTS anywhere — including DEAD ones (a traced-but-
+  unused jnp.zeros(bool) still lowers) and always-false iota compares
+  (constant-folded back into i1 splats): all-false masks derive from
+  runtime data (`g < 0`);
+- no i1 loop carries (scf.for fails to legalize): bools widen to i32
+  across the fori_loop boundary;
+- no i1 transposes (mask relayout materializes constants LLO cannot
+  build): the per-node outbox widens to i32 BEFORE the vmap stacking
+  transpose, and dead-sender erasure uses `where` on the i32 slots.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.node import CANDIDATE, FOLLOWER, LEADER, NO_VOTE
+from raft_tpu.sim.run import Metrics
+from raft_tpu.sim.state import BOOL, I32, Mailbox, PerNode, State
+from raft_tpu.utils import jrng
+
+LANE = 128   # lane width: trailing dim of every k-state leaf
+SUB = 8      # sublanes per block (min: block sublane dim must be 8-divisible)
+GB = SUB * LANE   # groups per block (1024): ~5 MB of VMEM state/block
+
+
+def supported(cfg: RaftConfig) -> bool:
+    """The statically-specialized subset this kernel implements."""
+    return (cfg.reconfig_u32 == 0 and not cfg.prevote
+            and cfg.transfer_u32 == 0 and cfg.read_every == 0)
+
+
+# ----------------------------------------------------------- small helpers
+
+
+def _col(n: int):
+    """i32 [n, 1, 1] iota for one-hot masks over a leading axis."""
+    return jax.lax.broadcasted_iota(I32, (n, 1, 1), 0)
+
+
+def _lget(arr, idx):
+    """arr[idx] over the leading axis via one-hot reduce:
+    [N,8,128],[8,128] -> [8,128]."""
+    return jnp.sum(jnp.where(_col(arr.shape[0]) == idx, arr, 0), axis=0)
+
+
+def _lset(arr, idx, cond, val):
+    """Masked arr[idx] = val over the leading axis via one-hot select."""
+    return jnp.where((_col(arr.shape[0]) == idx) & cond, val, arr)
+
+
+def _put(arr, p: int, cond, val):
+    """Masked write of row p (static): the kernel's `step._put`. Bool
+    rows use and/or masking with literal True/False short-circuited,
+    keeping vector i1 constants out of the program (module docstring)."""
+    m = (_col(arr.shape[0]) == p) & cond
+    if arr.dtype == jnp.bool_:
+        if val is True:
+            return arr | m
+        if val is False:
+            return arr & ~m
+        return (arr & ~m) | (m & val)
+    return jnp.where(m, val, arr)
+
+
+def _krow_or(arr, j: int, cond):
+    """arr[j] |= cond (bool [K,8,128] row update, static j)."""
+    return arr | ((_col(arr.shape[0]) == j) & cond)
+
+
+def _slot(cfg: RaftConfig, idx):
+    return (idx - 1) % cfg.log_cap
+
+
+def _term_at(cfg, ns: PerNode, idx):
+    return jnp.where(idx == ns.snap_index, ns.snap_term,
+                     _lget(ns.log_term, _slot(cfg, idx)))
+
+
+def _payload_at(cfg, ns: PerNode, idx):
+    return _lget(ns.log_payload, _slot(cfg, idx))
+
+
+def _last_log_term(cfg, ns: PerNode):
+    return _term_at(cfg, ns, ns.last_index)
+
+
+def _abs_index(cfg, ns: PerNode):
+    """step._abs_index: [L, 8, 128] absolute index per ring slot."""
+    off = _col(cfg.log_cap) - ns.snap_index % cfg.log_cap
+    return ns.snap_index + 1 + jnp.where(off >= 0, off, off + cfg.log_cap)
+
+
+def _vote_count(votes):
+    """ops.quorum.vote_count over the leading K axis."""
+    return jnp.sum(votes.astype(I32), axis=0)
+
+
+def _commit_candidate(cfg, match_index, last_index, i):
+    """ops.quorum.commit_candidate as a static compare-exchange network
+    (jnp.sort has no Mosaic lowering). Peer values with the self slot
+    forced to -1, sorted descending; element majority-2 is the
+    candidate."""
+    if cfg.majority == 1:
+        return last_index
+    rows = [jnp.where(jnp.int32(j) == i, jnp.int32(-1), match_index[j])
+            for j in range(cfg.k)]
+    for a in range(cfg.k):          # selection-sort network, descending
+        for b in range(a + 1, cfg.k):
+            hi = jnp.maximum(rows[a], rows[b])
+            lo = jnp.minimum(rows[a], rows[b])
+            rows[a], rows[b] = hi, lo
+    return rows[cfg.majority - 2]
+
+
+# -------------------------------------------------------------- transitions
+# Ports of step.py's masked transition helpers (same names, same order
+# of field writes). `g` is the [8, 128] group-id tile; `i` the node's
+# id ([1, 1] tile under the node vmap).
+
+
+def _reset_timer(cfg, ns: PerNode, g, i, cond):
+    deadline = jrng.election_deadline(cfg.seed, g, i, ns.rng_draws,
+                                      cfg.election_min, cfg.election_range)
+    return ns._replace(
+        election_elapsed=jnp.where(cond, 0, ns.election_elapsed),
+        deadline=jnp.where(cond, deadline, ns.deadline),
+        rng_draws=ns.rng_draws + cond.astype(I32),
+    )
+
+
+def _step_down(cfg, ns: PerNode, new_term, cond):
+    return ns._replace(
+        term=jnp.where(cond, new_term, ns.term),
+        role=jnp.where(cond, FOLLOWER, ns.role),
+        voted_for=jnp.where(cond, NO_VOTE, ns.voted_for),
+        leader_id=jnp.where(cond, NO_VOTE, ns.leader_id),
+        votes=ns.votes & ~cond,
+    )
+
+
+def _become_leader(cfg, ns: PerNode, i, cond):
+    ns = ns._replace(
+        role=jnp.where(cond, LEADER, ns.role),
+        leader_id=jnp.where(cond, i, ns.leader_id),
+        next_index=jnp.where(cond, ns.last_index + 1, ns.next_index),
+        match_index=jnp.where(cond, 0, ns.match_index),
+        heartbeat_elapsed=jnp.where(cond, cfg.heartbeat_every,
+                                    ns.heartbeat_elapsed),
+    )
+    top = cond & (ns.last_index > ns.commit)
+    return ns._replace(
+        log_term=_lset(ns.log_term, _slot(cfg, ns.last_index), top, ns.term))
+
+
+def _accept_leader(cfg, ns: PerNode, g, i, src: int, cond):
+    ns = ns._replace(
+        role=jnp.where(cond, FOLLOWER, ns.role),
+        leader_id=jnp.where(cond, src, ns.leader_id),
+        votes=ns.votes & ~cond,
+        leader_elapsed=jnp.where(cond, 0, ns.leader_elapsed),
+    )
+    return _reset_timer(cfg, ns, g, i, cond)
+
+
+# ----------------------------------------------------------------- phase D
+
+
+def _on_rv_req(cfg, ns, out, g, i, src: int, ib, gl):
+    present = ib.rv_req_present[src]
+    m_term = ib.rv_req_term[src]
+    m_lli = ib.rv_req_lli[src]
+    m_llt = ib.rv_req_llt[src]
+    ns = _step_down(cfg, ns, m_term, present & (m_term > ns.term))
+    llt = _last_log_term(cfg, ns)
+    log_ok = (m_llt > llt) | ((m_llt == llt) & (m_lli >= ns.last_index))
+    grant = (present & (m_term == ns.term)
+             & ((ns.voted_for == NO_VOTE) | (ns.voted_for == src))
+             & log_ok)
+    ns = ns._replace(voted_for=jnp.where(grant, src, ns.voted_for))
+    ns = _reset_timer(cfg, ns, g, i, grant)
+    out = out._replace(
+        rv_resp_present=_put(out.rv_resp_present, src, present, True),
+        rv_resp_term=_put(out.rv_resp_term, src, present, ns.term),
+        rv_resp_granted=_put(out.rv_resp_granted, src, present, grant),
+    )
+    return ns, out
+
+
+def _on_rv_resp(cfg, ns, out, g, i, src: int, ib, gl):
+    present = ib.rv_resp_present[src]
+    m_term = ib.rv_resp_term[src]
+    m_granted = ib.rv_resp_granted[src]
+    higher = present & (m_term > ns.term)
+    ns = _step_down(cfg, ns, m_term, higher)
+    cont = (present & ~higher & (ns.role == CANDIDATE)
+            & (m_term == ns.term) & m_granted)
+    votes = _krow_or(ns.votes, src, cont)
+    ns = ns._replace(votes=votes)
+    won = cont & (_vote_count(votes) >= cfg.majority)
+    return _become_leader(cfg, ns, i, won), out
+
+
+def _on_ae_req(cfg, ns, out, g, i, src: int, ib, gl):
+    """step._on_ae_req: receiver-pull log matching, decide-then-write."""
+    glog_t, glog_p = gl
+    present = ib.ae_req_present[src]
+    m_term = ib.ae_req_term[src]
+    m_prev = ib.ae_req_prev_index[src]
+    m_prev_term = ib.ae_req_prev_term[src]
+    m_n = ib.ae_req_n[src]
+    m_commit = ib.ae_req_commit[src]
+    ent_t = [_lget(glog_t[src], _slot(cfg, m_prev + 1 + j))
+             for j in range(cfg.max_entries_per_msg)]
+    ent_p = [_lget(glog_p[src], _slot(cfg, m_prev + 1 + j))
+             for j in range(cfg.max_entries_per_msg)]
+
+    ns = _step_down(cfg, ns, m_term, present & (m_term > ns.term))
+    stale = present & (m_term < ns.term)
+    ok = present & ~stale
+    ns = _accept_leader(cfg, ns, g, i, src, ok)
+
+    past = ok & (m_prev > ns.last_index)
+    conflict = (ok & ~past & (m_prev >= ns.snap_index)
+                & (_term_at(cfg, ns, m_prev) != m_prev_term))
+    ct = _term_at(cfg, ns, m_prev)
+    absidx = _abs_index(cfg, ns)
+    bad = ((absidx > ns.snap_index) & (absidx < m_prev)
+           & (ns.log_term != ct))
+    ci = jnp.minimum(
+        jnp.max(jnp.where(bad, absidx, ns.snap_index), axis=0) + 1, m_prev)
+
+    proceed = ok & ~past & ~conflict
+    j0 = jnp.maximum(0, ns.snap_index - m_prev)
+    hi = m_prev + j0
+    last_index = ns.last_index
+    stopped = proceed & (g < 0)                 # all-false, constant-free
+    write_t, write_p, slots = [], [], []
+    for j in range(cfg.max_entries_per_msg):
+        idx = m_prev + 1 + j
+        act = proceed & (j >= j0) & (j < m_n) & ~stopped
+        s = _slot(cfg, idx)
+        slots.append(s)
+        in_log = act & (idx <= last_index)
+        same_t = in_log & (_lget(ns.log_term, s) == ent_t[j])
+        same_p = in_log & ~same_t & (_lget(ns.log_payload, s) == ent_p[j])
+        diverge = in_log & ~same_t & ~same_p
+        need_append = (act & ~in_log) | diverge
+        room = (idx - ns.snap_index) <= cfg.log_cap
+        do_append = need_append & room
+        write_t.append(same_p | do_append)
+        write_p.append(do_append)
+        last_index = jnp.where(
+            do_append, idx,
+            jnp.where(diverge & ~room, idx - 1, last_index))
+        stopped = stopped | (need_append & ~room)
+        hi = jnp.where(same_t | same_p | do_append, idx, hi)
+    lanes = _col(cfg.log_cap)
+    t_mask = jnp.broadcast_to(g, (cfg.log_cap,) + g.shape) < 0  # all-false
+    p_mask = t_mask
+    t_val = jnp.zeros((cfg.log_cap, 1, 1), I32) + (last_index & 0)
+    p_val = t_val
+    for j in range(cfg.max_entries_per_msg):
+        on_j = lanes == slots[j]
+        t_mask = t_mask | (on_j & write_t[j])
+        p_mask = p_mask | (on_j & write_p[j])
+        t_val = jnp.where(on_j, ent_t[j], t_val)
+        p_val = jnp.where(on_j, ent_p[j], p_val)
+    log_term = jnp.where(t_mask, t_val, ns.log_term)
+    log_payload = jnp.where(p_mask, p_val, ns.log_payload)
+
+    commit = jnp.where(
+        proceed & (m_commit > ns.commit),
+        jnp.maximum(ns.commit, jnp.minimum(m_commit, hi)),
+        ns.commit)
+    ns = ns._replace(log_term=log_term, log_payload=log_payload,
+                     last_index=last_index, commit=commit)
+
+    match = jnp.where(
+        past, last_index + 1,
+        jnp.where(conflict, ci, jnp.where(proceed, hi, 0)))
+    out = out._replace(
+        ae_resp_present=_put(out.ae_resp_present, src, present, True),
+        ae_resp_term=_put(out.ae_resp_term, src, present, ns.term),
+        ae_resp_success=_put(out.ae_resp_success, src, present, proceed),
+        ae_resp_match=_put(out.ae_resp_match, src, present, match),
+    )
+    return ns, out
+
+
+def _on_ae_resp(cfg, ns, out, g, i, src: int, ib, gl):
+    present = ib.ae_resp_present[src]
+    m_term = ib.ae_resp_term[src]
+    m_success = ib.ae_resp_success[src]
+    m_match = ib.ae_resp_match[src]
+    higher = present & (m_term > ns.term)
+    ns = _step_down(cfg, ns, m_term, higher)
+    cont = present & ~higher & (ns.role == LEADER) & (m_term == ns.term)
+    succ = cont & m_success
+    fail = cont & ~m_success
+    old_match = ns.match_index[src]
+    old_next = ns.next_index[src]
+    new_match = jnp.maximum(old_match, m_match)
+    kio = _col(cfg.k)
+    match_index = jnp.where((kio == src) & succ, new_match, ns.match_index)
+    next_index = jnp.where(
+        kio == src,
+        jnp.where(succ, new_match + 1,
+                  jnp.where(fail,
+                            jnp.maximum(1, jnp.minimum(old_next - 1, m_match)),
+                            old_next)),
+        ns.next_index)
+    return ns._replace(match_index=match_index, next_index=next_index), out
+
+
+def _on_is_req(cfg, ns, out, g, i, src: int, ib, gl):
+    present = ib.is_req_present[src]
+    m_term = ib.is_req_term[src]
+    m_si = ib.is_req_snap_index[src]
+    m_st = ib.is_req_snap_term[src]
+    m_sd = ib.is_req_snap_digest[src]
+    m_sv = ib.is_req_snap_voters[src]
+    ns = _step_down(cfg, ns, m_term, present & (m_term > ns.term))
+    stale = present & (m_term < ns.term)
+    ok = present & ~stale
+    ns = _accept_leader(cfg, ns, g, i, src, ok)
+    have = ok & (m_si <= ns.commit)
+    inst = ok & ~have
+    keep = (inst & (m_si <= ns.last_index) & (m_si >= ns.snap_index)
+            & (_term_at(cfg, ns, jnp.maximum(m_si, ns.snap_index)) == m_st))
+    ns = ns._replace(
+        last_index=jnp.where(inst, jnp.where(keep, ns.last_index, m_si),
+                             ns.last_index),
+        snap_index=jnp.where(inst, m_si, ns.snap_index),
+        snap_term=jnp.where(inst, m_st, ns.snap_term),
+        snap_digest=jnp.where(inst, m_sd, ns.snap_digest),
+        snap_voters=jnp.where(inst, m_sv, ns.snap_voters),
+        commit=jnp.where(inst, m_si, ns.commit),
+        applied=jnp.where(inst, m_si, ns.applied),
+        digest=jnp.where(inst, m_sd, ns.digest),
+    )
+    match = jnp.where(stale, 0, jnp.where(have, ns.commit, m_si))
+    out = out._replace(
+        is_resp_present=_put(out.is_resp_present, src, present, True),
+        is_resp_term=_put(out.is_resp_term, src, present, ns.term),
+        is_resp_match=_put(out.is_resp_match, src, present, match),
+    )
+    return ns, out
+
+
+def _on_is_resp(cfg, ns, out, g, i, src: int, ib, gl):
+    present = ib.is_resp_present[src]
+    m_term = ib.is_resp_term[src]
+    m_match = ib.is_resp_match[src]
+    higher = present & (m_term > ns.term)
+    ns = _step_down(cfg, ns, m_term, higher)
+    cont = present & ~higher & (ns.role == LEADER) & (m_term == ns.term)
+    old_match = ns.match_index[src]
+    new_match = jnp.maximum(old_match, m_match)
+    kio = _col(cfg.k)
+    match_index = jnp.where((kio == src) & cont, new_match, ns.match_index)
+    next_index = jnp.where((kio == src) & cont, new_match + 1, ns.next_index)
+    return ns._replace(match_index=match_index, next_index=next_index), out
+
+
+def _start_election_masked(cfg, ns, out, g, i, cond):
+    ns = ns._replace(
+        term=jnp.where(cond, ns.term + 1, ns.term),
+        role=jnp.where(cond, CANDIDATE, ns.role),
+        voted_for=jnp.where(cond, i, ns.voted_for),
+        leader_id=jnp.where(cond, NO_VOTE, ns.leader_id),
+        votes=(ns.votes & ~cond) | (cond & (_col(cfg.k) == i)),
+    )
+    ns = _reset_timer(cfg, ns, g, i, cond)
+    won = cond & (_vote_count(ns.votes) >= cfg.majority)
+    ns = _become_leader(cfg, ns, i, won)
+    llt = _last_log_term(cfg, ns)
+    for p in range(cfg.k):
+        send = cond & ~won & (i != p)
+        out = out._replace(
+            rv_req_present=_put(out.rv_req_present, p, send, True),
+            rv_req_term=_put(out.rv_req_term, p, send, ns.term),
+            rv_req_lli=_put(out.rv_req_lli, p, send, ns.last_index),
+            rv_req_llt=_put(out.rv_req_llt, p, send, llt),
+        )
+    return ns, out
+
+
+_HANDLERS = (_on_rv_req, _on_rv_resp, _on_ae_req, _on_ae_resp,
+             _on_is_req, _on_is_resp)
+
+
+# ------------------------------------------------------------- phases T/C/A
+
+
+def _phase_t(cfg, ns, out, g, i, t):
+    is_leader = ns.role == LEADER
+    hb = ns.heartbeat_elapsed + 1
+    fire = is_leader & (hb >= cfg.heartbeat_every)
+    ns = ns._replace(heartbeat_elapsed=jnp.where(
+        is_leader, jnp.where(fire, 0, hb), ns.heartbeat_elapsed))
+
+    for p in range(cfg.k):
+        cond = fire & (i != p)
+        next_p = ns.next_index[p]
+        use_is = cond & (next_p <= ns.snap_index)
+        use_ae = cond & (next_p > ns.snap_index)
+        out = out._replace(
+            is_req_present=_put(out.is_req_present, p, use_is, True),
+            is_req_term=_put(out.is_req_term, p, use_is, ns.term),
+            is_req_snap_index=_put(out.is_req_snap_index, p, use_is,
+                                   ns.snap_index),
+            is_req_snap_term=_put(out.is_req_snap_term, p, use_is,
+                                  ns.snap_term),
+            is_req_snap_digest=_put(out.is_req_snap_digest, p, use_is,
+                                    ns.snap_digest),
+            is_req_snap_voters=_put(out.is_req_snap_voters, p, use_is,
+                                    ns.snap_voters),
+        )
+        prev = next_p - 1
+        n = jnp.minimum(cfg.max_entries_per_msg, ns.last_index - prev)
+        out = out._replace(
+            ae_req_present=_put(out.ae_req_present, p, use_ae, True),
+            ae_req_term=_put(out.ae_req_term, p, use_ae, ns.term),
+            ae_req_prev_index=_put(out.ae_req_prev_index, p, use_ae, prev),
+            ae_req_prev_term=_put(out.ae_req_prev_term, p, use_ae,
+                                  _term_at(cfg, ns, prev)),
+            ae_req_n=_put(out.ae_req_n, p, use_ae, n),
+            ae_req_commit=_put(out.ae_req_commit, p, use_ae, ns.commit),
+        )
+
+    ee = ns.election_elapsed + 1
+    timeout = ~is_leader & (ee >= ns.deadline)
+    ns = ns._replace(
+        election_elapsed=jnp.where(is_leader, ns.election_elapsed, ee),
+        leader_elapsed=jnp.where(is_leader, 0, ns.leader_elapsed + 1))
+    return _start_election_masked(cfg, ns, out, g, i, timeout)
+
+
+def _phase_c(cfg, ns, g, t):
+    lead = ns.role == LEADER
+    last_index = ns.last_index
+    log_term, log_payload = ns.log_term, ns.log_payload
+    stopped = lead & (g < 0)                    # all-false, constant-free
+    for _ in range(cfg.cmds_per_tick):
+        idx = last_index + 1
+        room = (idx - ns.snap_index) <= cfg.log_cap
+        do = lead & room & ~stopped
+        payload = jrng.client_payload(cfg.seed, g, ns.term, idx)
+        s = _slot(cfg, idx)
+        log_term = _lset(log_term, s, do, ns.term)
+        log_payload = _lset(log_payload, s, do, payload)
+        last_index = jnp.where(do, idx, last_index)
+        stopped = stopped | (lead & ~room)
+    return ns._replace(last_index=last_index, log_term=log_term,
+                       log_payload=log_payload)
+
+
+def _phase_a(cfg, ns, i):
+    n = _commit_candidate(cfg, ns.match_index, ns.last_index, i)
+    advance = ((ns.role == LEADER) & (n > ns.commit)
+               & (_term_at(cfg, ns, n) == ns.term))
+    commit = jnp.where(advance, n, ns.commit)
+
+    applied, digest = ns.applied, ns.digest
+    for _ in range(cfg.log_cap):
+        idx = applied + 1
+        act = idx <= commit
+        digest = jnp.where(
+            act, jrng.digest_update(digest, idx, _payload_at(cfg, ns, idx)),
+            digest)
+        applied = jnp.where(act, idx, applied)
+
+    compact = (commit - ns.snap_index) >= cfg.compact_every
+    return ns._replace(
+        commit=commit, applied=applied, digest=digest,
+        snap_term=jnp.where(compact, _term_at(cfg, ns, commit), ns.snap_term),
+        snap_index=jnp.where(compact, commit, ns.snap_index),
+        snap_digest=jnp.where(compact, digest, ns.snap_digest),
+    )
+
+
+def _node_tick(cfg, t, ns: PerNode, inbox, g, i, glog_t, glog_p):
+    """step._node_tick, [8,128]-tile flavor; vmapped over the node axis.
+    The empty outbox derives its all-false rows from runtime data
+    (module docstring)."""
+    fK = jnp.broadcast_to(g, (cfg.k,) + g.shape) < 0
+    zK = jnp.zeros((cfg.k, 1, 1), I32) + (g & 0)
+    zKu = zK.astype(jnp.uint32)
+    out = Mailbox(
+        rv_req_present=fK, rv_resp_present=fK, rv_resp_granted=fK,
+        ae_req_present=fK, ae_resp_present=fK, ae_resp_success=fK,
+        is_req_present=fK, is_resp_present=fK,
+        rv_req_term=zK, rv_req_lli=zK, rv_req_llt=zK, rv_resp_term=zK,
+        ae_req_term=zK, ae_req_prev_index=zK, ae_req_prev_term=zK,
+        ae_req_n=zK, ae_req_commit=zK, ae_resp_term=zK, ae_resp_match=zK,
+        is_req_term=zK, is_req_snap_index=zK, is_req_snap_term=zK,
+        is_req_snap_digest=zKu, is_req_snap_voters=zK,
+        is_resp_term=zK, is_resp_match=zK)
+    gl = (glog_t, glog_p)
+    for handler in _HANDLERS:
+        for src in range(cfg.k):
+            ns, out = handler(cfg, ns, out, g, i, src, inbox, gl)
+    ns, out = _phase_t(cfg, ns, out, g, i, t)
+    ns = _phase_c(cfg, ns, g, t)
+    ns = _phase_a(cfg, ns, i)
+    # Outbox bools leave the per-node step widened to i32: the vmap
+    # out_axes=1 stacking transposes the node axis, and Mosaic's i1
+    # relayout path materializes mask constants LLO cannot build.
+    out = jax.tree.map(
+        lambda a: a.astype(I32) if a.dtype == jnp.bool_ else a, out)
+    return ns, out
+
+
+# ------------------------------------------------------------- global tick
+
+
+def _apply_restart(cfg, nodes: PerNode, g, edge):
+    """step._apply_restart on [K, 8, 128] leaves (edge: [K, 8, 128])."""
+    kio = jax.lax.broadcasted_iota(I32, (cfg.k, 1, 1), 0)
+    new_deadline = jrng.election_deadline(cfg.seed, g[None], kio,
+                                          nodes.rng_draws, cfg.election_min,
+                                          cfg.election_range)
+    e1 = edge[:, None]
+    return nodes._replace(
+        role=jnp.where(edge, FOLLOWER, nodes.role),
+        leader_id=jnp.where(edge, NO_VOTE, nodes.leader_id),
+        commit=jnp.where(edge, nodes.snap_index, nodes.commit),
+        applied=jnp.where(edge, nodes.snap_index, nodes.applied),
+        digest=jnp.where(edge, nodes.snap_digest, nodes.digest),
+        votes=nodes.votes & ~e1,
+        next_index=jnp.where(e1, 1, nodes.next_index),
+        match_index=jnp.where(e1, 0, nodes.match_index),
+        heartbeat_elapsed=jnp.where(edge, 0, nodes.heartbeat_elapsed),
+        election_elapsed=jnp.where(edge, 0, nodes.election_elapsed),
+        leader_elapsed=jnp.where(edge, 0, nodes.leader_elapsed),
+        deadline=jnp.where(edge, new_deadline, nodes.deadline),
+        rng_draws=nodes.rng_draws + edge.astype(I32),
+        ack_time=jnp.where(e1, -1, nodes.ack_time),
+        sched_read_index=jnp.where(edge, -1, nodes.sched_read_index),
+        reads_done=jnp.where(edge, 0, nodes.reads_done),
+    )
+
+
+def _filter_mailbox(cfg, mb: Mailbox, t, alive_now, g) -> Mailbox:
+    """step._filter_mailbox on [dst, src, 8, 128] leaves."""
+    dst = jax.lax.broadcasted_iota(I32, (cfg.k, cfg.k, 1, 1), 0)
+    src = jax.lax.broadcasted_iota(I32, (cfg.k, cfg.k, 1, 1), 1)
+    gg = g[None, None]
+    keep = alive_now[:, None]     # [K,1,8,128] dst-alive, broadcast over src
+    if cfg.partition_u32:
+        keep = keep & ~jrng.link_partitioned(cfg.seed, gg, t, src, dst,
+                                             cfg.partition_u32,
+                                             cfg.partition_epoch)
+    if cfg.drop_u32:
+        keep = keep & ~jrng.link_dropped(cfg.seed, gg, t, src, dst,
+                                         cfg.drop_u32)
+    return mb._replace(
+        rv_req_present=mb.rv_req_present & keep,
+        rv_resp_present=mb.rv_resp_present & keep,
+        ae_req_present=mb.ae_req_present & keep,
+        ae_resp_present=mb.ae_resp_present & keep,
+        is_req_present=mb.is_req_present & keep,
+        is_resp_present=mb.is_resp_present & keep,
+    )
+
+
+def _tick(cfg, nodes, mailbox, alive_prev, g, t):
+    """step.tick over k-state values. g: [8,128] group ids; t: scalar."""
+    kio = jax.lax.broadcasted_iota(I32, (cfg.k, 1, 1), 0)
+    if cfg.crash_u32 == 0:
+        alive_now = jnp.broadcast_to(g[None], (cfg.k,) + g.shape) >= 0
+    else:
+        alive_now = jnp.broadcast_to(
+            jrng.node_alive(cfg.seed, g[None], kio, t,
+                            cfg.crash_u32, cfg.crash_epoch),
+            (cfg.k,) + g.shape)
+    nodes = _apply_restart(cfg, nodes, g, alive_now & ~alive_prev)
+    inbox = _filter_mailbox(cfg, mailbox, t, alive_now, g)
+
+    node_fn = functools.partial(_node_tick, cfg, t)
+    new_nodes, outbox = jax.vmap(
+        node_fn, in_axes=(0, 0, None, 0, None, None), out_axes=(0, 1))(
+        nodes, inbox, g, kio, nodes.log_term, nodes.log_payload)
+
+    def freeze(new, old):
+        m = alive_now.reshape(
+            alive_now.shape[:1] + (1,) * (new.ndim - 3) + alive_now.shape[1:])
+        if new.dtype == jnp.bool_:      # no select on i1 (Mosaic trunci)
+            return (new & m) | (old & ~m)
+        return jnp.where(m, new, old)
+
+    new_nodes = jax.tree.map(freeze, new_nodes, nodes)
+    src_alive = alive_now[None]        # [1, K_src, 8, 128]
+
+    def erase(p):   # presence slots are i32 here (see _node_tick tail)
+        return jnp.where(src_alive, p, 0)
+
+    outbox = outbox._replace(
+        rv_req_present=erase(outbox.rv_req_present),
+        rv_resp_present=erase(outbox.rv_resp_present),
+        ae_req_present=erase(outbox.ae_req_present),
+        ae_resp_present=erase(outbox.ae_resp_present),
+        is_req_present=erase(outbox.is_req_present),
+        is_resp_present=erase(outbox.is_resp_present),
+    )
+    return new_nodes, outbox, alive_now
+
+
+# -------------------------------------------------------- kernel + wrapper
+
+_MB_BOOL = ("rv_req_present", "rv_resp_present", "rv_resp_granted",
+            "ae_req_present", "ae_resp_present", "ae_resp_success",
+            "is_req_present", "is_resp_present")
+
+_OPTIONAL_MB = ("pv_req_present", "pv_req_term", "pv_req_lli", "pv_req_llt",
+                "pv_resp_present", "pv_resp_term", "pv_resp_req_term",
+                "pv_resp_granted", "tn_present", "tn_term")
+
+
+class KMetrics(NamedTuple):
+    """Per-group metric tiles carried through the kernel ([8, 128] per
+    block; [GS, 128] in HBM). `elections` / `max_latency` are per-GROUP
+    here (run.Metrics keeps scalars); prun reduces them host-side. No
+    histogram: the fused chunk serves the throughput/elections benches —
+    latency-histogram runs use the XLA path (sim.run), which folds
+    metrics every tick."""
+    committed: jnp.ndarray
+    leaderless: jnp.ndarray
+    elections: jnp.ndarray
+    max_latency: jnp.ndarray
+
+
+def _metrics_tick(m: KMetrics, nodes, alive_now) -> KMetrics:
+    """run.metrics_update against k-state values (minus the histogram)."""
+    committed = jnp.maximum(m.committed, jnp.max(nodes.commit, axis=0))
+    has_leader = jnp.any((nodes.role == LEADER) & alive_now, axis=0)
+    done = has_leader & (m.leaderless > 0)
+    return KMetrics(
+        committed=committed,
+        leaderless=jnp.where(has_leader, 0, m.leaderless + 1),
+        elections=m.elections + done.astype(I32),
+        max_latency=jnp.maximum(m.max_latency,
+                                jnp.where(done, m.leaderless, 0)),
+    )
+
+
+def _node_leaves(cfg):
+    """(field, kind) per PerNode leaf; kind: 'scalar'|'peer'|'ring'."""
+    kinds = {"votes": "peer", "next_index": "peer", "match_index": "peer",
+             "ack_time": "peer", "log_term": "ring", "log_payload": "ring"}
+    return [(f, kinds.get(f, "scalar")) for f in PerNode._fields]
+
+
+def _mb_fields(cfg):
+    """Static names of the mailbox leaves in the supported subset. NO
+    array construction: this runs inside the kernel trace, where even a
+    dead jnp.zeros(bool) lowers to an i1 vector constant LLO rejects."""
+    return [f for f in Mailbox._fields if f not in _OPTIONAL_MB]
+
+
+def _fold_g(a):
+    """[..., G] -> [..., G/LANE, LANE]."""
+    return a.reshape(a.shape[:-1] + (a.shape[-1] // LANE, LANE))
+
+
+def _unfold_g(a):
+    return a.reshape(a.shape[:-2] + (a.shape[-2] * a.shape[-1],))
+
+
+def _to_kstate(cfg, st: State):
+    """State (G a GB multiple) -> flat list of k-state arrays (leaf
+    order: node leaves, mailbox leaves, alive_prev, group_id; bools as
+    i32; trailing G folded to [GS, LANE])."""
+    out = []
+    for f, kind in _node_leaves(cfg):
+        a = getattr(st.nodes, f)
+        if kind == "scalar":
+            a = jnp.transpose(a, (1, 0))                  # [K, G]
+        else:
+            a = jnp.transpose(a, (1, 2, 0))               # [K, K|L, G]
+        if a.dtype == jnp.bool_:
+            a = a.astype(I32)
+        out.append(_fold_g(a))
+    for f in _mb_fields(cfg):
+        a = jnp.transpose(getattr(st.mailbox, f), (1, 2, 0))
+        if a.dtype == jnp.bool_:
+            a = a.astype(I32)
+        out.append(_fold_g(a))
+    out.append(_fold_g(jnp.transpose(st.alive_prev, (1, 0)).astype(I32)))
+    out.append(_fold_g(st.group_id))
+    return out
+
+
+def _from_kstate(cfg, flat, g: int) -> State:
+    """Inverse of _to_kstate from UNFOLDED (flat-G) leaves, slicing off
+    any pad groups beyond `g`."""
+    it = iter(a[..., :g] for a in flat)
+    nd = {}
+    for f, kind in _node_leaves(cfg):
+        a = next(it)
+        if kind == "scalar":
+            a = jnp.transpose(a, (1, 0))
+        else:
+            a = jnp.transpose(a, (2, 0, 1))
+        nd[f] = a
+    nd["votes"] = nd["votes"].astype(BOOL)
+    nd["snap_digest"] = nd["snap_digest"].astype(jnp.uint32)
+    nd["digest"] = nd["digest"].astype(jnp.uint32)
+    md = {}
+    for f in _mb_fields(cfg):
+        a = jnp.transpose(next(it), (2, 0, 1))
+        if f in _MB_BOOL:
+            a = a.astype(BOOL)
+        elif f == "is_req_snap_digest":
+            a = a.astype(jnp.uint32)
+        md[f] = a
+    alive = jnp.transpose(next(it), (1, 0)).astype(BOOL)
+    gid = next(it)
+    return State(nodes=PerNode(**nd), mailbox=Mailbox(**md),
+                 alive_prev=alive, group_id=gid)
+
+
+def _build_kernel(cfg, n_ticks):
+    """The pallas kernel body: load block -> fori_loop of ticks -> store."""
+    node_kinds = _node_leaves(cfg)
+    mb_fields = _mb_fields(cfg)
+    n_in = len(node_kinds) + len(mb_fields) + 2 + 4   # + alive,gid + metrics
+
+    def kernel(t0_ref, *refs):
+        in_refs = refs[:n_in]
+        out_refs = refs[n_in:]
+        it = iter(in_refs)
+        nd = {}
+        for f, kind in node_kinds:
+            a = next(it)[:]
+            if f == "votes":
+                a = a != 0
+            elif f in ("snap_digest", "digest"):
+                a = a.astype(jnp.uint32)
+            nd[f] = a
+        md = {}
+        for f in mb_fields:
+            a = next(it)[:]
+            if f in _MB_BOOL:
+                a = a != 0
+            elif f == "is_req_snap_digest":
+                a = a.astype(jnp.uint32)
+            md[f] = a
+        alive_prev = next(it)[:] != 0
+        g = next(it)[:]
+        met = KMetrics(committed=next(it)[:], leaderless=next(it)[:],
+                       elections=next(it)[:], max_latency=next(it)[:])
+        nodes = PerNode(**nd)
+        mailbox = Mailbox(**md)
+        t0 = t0_ref[0]
+
+        # The loop carry is i32-only: Mosaic fails to legalize scf.for
+        # with i1 vector block arguments, so bool leaves cross the loop
+        # boundary widened and are re-derived each iteration.
+        def widen(tree):
+            return jax.tree.map(
+                lambda a: a.astype(I32) if a.dtype == jnp.bool_ else a, tree)
+
+        def narrow_like(tree, proto):
+            return jax.tree.map(
+                lambda a, pr: a != 0 if pr.dtype == jnp.bool_ else a,
+                tree, proto)
+
+        proto = (nodes, mailbox, alive_prev)
+
+        def body(tt, carry):
+            state_i, met = carry
+            nodes, mailbox, alive_prev = narrow_like(state_i, proto)
+            nodes, mailbox, alive_now = _tick(cfg, nodes, mailbox,
+                                              alive_prev, g, t0 + tt)
+            met = _metrics_tick(met, nodes, alive_now)
+            return widen((nodes, mailbox, alive_now)), met
+
+        state_i, met = jax.lax.fori_loop(
+            0, n_ticks, body, (widen((nodes, mailbox, alive_prev)), met))
+        nodes, mailbox, alive_prev = narrow_like(state_i, proto)
+
+        ot = iter(out_refs)
+        for f, _ in node_kinds:
+            a = getattr(nodes, f)
+            next(ot)[:] = a.astype(I32) \
+                if a.dtype in (jnp.bool_, jnp.uint32) else a
+        for f in mb_fields:
+            a = getattr(mailbox, f)
+            next(ot)[:] = a.astype(I32) \
+                if a.dtype in (jnp.bool_, jnp.uint32) else a
+        next(ot)[:] = alive_prev.astype(I32)
+        next(ot)[:] = g
+        next(ot)[:] = met.committed
+        next(ot)[:] = met.leaderless
+        next(ot)[:] = met.elections
+        next(ot)[:] = met.max_latency
+
+    return kernel
+
+
+def _gspec(a):
+    """BlockSpec cutting SUB-wide slices of the folded GS axis (dim -2)."""
+    lead = a.shape[:-2]
+    zeros = (0,) * len(lead)
+
+    def imap(b, _z=zeros):
+        return _z + (b, 0)
+
+    return pl.BlockSpec(lead + (SUB, LANE), imap)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n_ticks", "interpret"))
+def _prun_padded(cfg, leaves, t0, n_ticks, interpret=False):
+    kernel = _build_kernel(cfg, n_ticks)
+    nb = leaves[0].shape[-2] // SUB
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+    in_specs += [_gspec(a) for a in leaves]
+    out_shape = [jax.ShapeDtypeStruct(a.shape, I32) for a in leaves]
+    out_specs = [_gspec(a) for a in leaves]
+    t0a = jnp.asarray([t0], I32)
+    return pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=in_specs,
+        out_shape=out_shape,
+        out_specs=out_specs,
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+    )(t0a, *leaves)
+
+
+def kinit(cfg: RaftConfig, st: State, metrics: Metrics | None = None):
+    """Convert (State, Metrics) to the kernel wire form ONCE. Returns
+    (leaves, g): `leaves` is the flat tuple `kstep` launches on, `g`
+    the unpadded group count. The conversion transposes the whole
+    state; at 100K groups it costs more than a 200-tick kernel launch,
+    so chunked drivers must call kinit/kfinish once around the chunk
+    loop, never per chunk (that mistake hid the kernel's speed behind
+    2s/chunk of host-side reshuffling when first measured)."""
+    from raft_tpu.sim.run import metrics_init
+    g = st.alive_prev.shape[0]
+    if metrics is None:
+        metrics = metrics_init(g)
+    pad = (-g) % GB
+    if pad:
+        # Pad groups simulate alongside (results sliced off at finish);
+        # their group ids continue past g, keeping seed streams distinct.
+        def padg(a):
+            w = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            return jnp.pad(a, w)
+        stp = jax.tree.map(padg, st)
+        stp = stp._replace(group_id=jnp.concatenate(
+            [st.group_id, jnp.arange(g, g + pad, dtype=I32)]))
+        mc = jnp.pad(metrics.committed, (0, pad))
+        ml = jnp.pad(metrics.leaderless, (0, pad))
+    else:
+        stp, mc, ml = st, metrics.committed, metrics.leaderless
+    leaves = _to_kstate(cfg, stp)
+    mleaves = [_fold_g(mc), _fold_g(ml),
+               _fold_g(jnp.zeros(g + pad, I32)),
+               _fold_g(jnp.zeros(g + pad, I32))]
+    return tuple(leaves + mleaves), g
+
+
+def kstep(cfg: RaftConfig, leaves, t0: int, n_ticks: int,
+          interpret: bool = False):
+    """One kernel launch: `n_ticks` ticks starting at absolute tick
+    `t0` (traced — chunked calls at advancing t0 reuse one compile).
+    Returns the evolved leaves tuple."""
+    return tuple(_prun_padded(cfg, tuple(leaves), int(t0), int(n_ticks),
+                              interpret=interpret))
+
+
+N_METRIC_LEAVES = 4
+
+
+def kcommitted(leaves, g: int) -> int:
+    """Host-side total committed rounds from the wire form (int64 sum —
+    run.total_rounds semantics)."""
+    import numpy as np
+    mc = np.asarray(_unfold_g(leaves[-N_METRIC_LEAVES]))[:g]
+    return int(mc.astype(np.int64).sum())
+
+
+def kelections(leaves, g: int) -> int:
+    import numpy as np
+    me = np.asarray(_unfold_g(leaves[-2]))[:g]
+    return int(me.astype(np.int64).sum())
+
+
+def kfinish(cfg: RaftConfig, leaves, g: int,
+            metrics_base: Metrics | None = None):
+    """Wire form -> (State, Metrics). `metrics_base` supplies the
+    histogram and prior elections/max_latency scalars to fold in (the
+    kernel tracks no histogram — module docstring)."""
+    from raft_tpu.sim.run import metrics_init
+    if metrics_base is None:
+        metrics_base = metrics_init(g)
+    n_state = len(leaves) - N_METRIC_LEAVES
+    st = _from_kstate(cfg, [_unfold_g(a) for a in leaves[:n_state]], g)
+    mc, ml, me, mx = [_unfold_g(a)[:g] for a in leaves[n_state:]]
+    met = Metrics(
+        committed=mc, leaderless=ml,
+        elections=metrics_base.elections + jnp.sum(me),
+        hist=metrics_base.hist,
+        max_latency=jnp.maximum(metrics_base.max_latency, jnp.max(mx)),
+    )
+    return st, met
+
+
+def prun(cfg: RaftConfig, st: State, n_ticks: int, t0: int = 0,
+         metrics: Metrics | None = None, interpret: bool = False):
+    """Drop-in for `sim.run.run` on supported configs: same (State,
+    Metrics) out, same bits, except Metrics.hist stays zero (use the
+    XLA path when the latency histogram is wanted). One launch + both
+    conversions — for chunked loops use kinit/kstep/kfinish directly."""
+    if not supported(cfg):
+        raise ValueError("pkernel: config needs the XLA path (run.run)")
+    leaves, g = kinit(cfg, st, metrics)
+    leaves = kstep(cfg, leaves, t0, n_ticks, interpret=interpret)
+    return kfinish(cfg, leaves, g, metrics)

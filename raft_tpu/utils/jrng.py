@@ -8,13 +8,17 @@ ordinary jnp ops, so they can be evaluated for whole [G], [G, K] or
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from raft_tpu.utils import rng as _r
 
-_GOLD = jnp.uint32(_r.GOLD)
-_SEED0 = jnp.uint32(0x243F6A88)
-_C1 = jnp.uint32(0x7FEB352D)
-_C2 = jnp.uint32(0x846CA68B)
+# np (not jnp) scalars: identical u32 arithmetic, but they inline as
+# literals wherever they are traced — a module-level jnp scalar is a
+# device array, which a pallas kernel body cannot close over.
+_GOLD = np.uint32(_r.GOLD)
+_SEED0 = np.uint32(0x243F6A88)
+_C1 = np.uint32(0x7FEB352D)
+_C2 = np.uint32(0x846CA68B)
 
 
 def _u32(x):
@@ -32,7 +36,7 @@ def mix32(x):
 
 
 def hash_u32(*vals):
-    h = _SEED0
+    h = _u32(_SEED0)   # trace-time jnp scalar: inlines as a literal
     for v in vals:
         h = mix32(h * _GOLD + _u32(v))
     return h

@@ -1,0 +1,94 @@
+"""Differential gate for the Pallas fused-chunk runner (sim/pkernel.py).
+
+The kernel must be BIT-IDENTICAL to the XLA path (sim.run.run), which
+the rest of the suite holds bit-identical to the CPU oracle — so these
+tests transitively pin the kernel to the oracle. They run in pallas
+interpret mode on the CPU test platform (conftest); the real-TPU
+compile is exercised by bench.py's runtime self-check, which falls back
+to the XLA path on any mismatch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.sim import pkernel, state
+from raft_tpu.sim.run import run
+
+
+def trees_equal(a, b) -> bool:
+    """Byte-identical pytree comparison (leaf-count mismatch fails)."""
+    import jax
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def _diff(cfg, n_ticks, chunks=None):
+    st0 = state.init(cfg)
+    stx, mx = run(cfg, st0, n_ticks)
+    if chunks is None:
+        stp, mp = pkernel.prun(cfg, st0, n_ticks, interpret=True)
+    else:
+        leaves, g = pkernel.kinit(cfg, st0)
+        at = 0
+        for ch in chunks:
+            leaves = pkernel.kstep(cfg, leaves, at, ch, interpret=True)
+            at += ch
+        assert at == n_ticks
+        stp, mp = pkernel.kfinish(cfg, leaves, g)
+    assert trees_equal(stx, stp), "state diverged from the XLA path"
+    assert np.array_equal(np.asarray(mx.committed), np.asarray(mp.committed))
+    assert np.array_equal(np.asarray(mx.leaderless),
+                          np.asarray(mp.leaderless))
+    assert int(mx.elections) == int(mp.elections)
+    assert int(mx.max_latency) == int(mp.max_latency)
+    return stp
+
+
+def test_headline_config_bit_exact():
+    """The bench headline shape (fault-free, k=5, L=32) in miniature,
+    including the pad path (12 groups -> one 1024-group block)."""
+    _diff(RaftConfig(n_groups=12, seed=42), 48)
+
+
+def test_fault_mix_bit_exact():
+    """Crash + partition + drop — every fault class the kernel supports
+    — with restarts exercising _apply_restart and mailbox filtering."""
+    cfg = RaftConfig(n_groups=16, k=3, seed=7, drop_prob=0.05,
+                     crash_prob=0.1, crash_epoch=16,
+                     partition_prob=0.2, partition_epoch=16)
+    _diff(cfg, 56)
+
+
+def test_chunked_resume_matches_single_run():
+    """kstep chunk boundaries are invisible: 3 launches == one 48-tick
+    run, bit-exact (the carry widens/narrows bools across the fori_loop
+    AND the launch boundary — both must round-trip)."""
+    cfg = RaftConfig(n_groups=8, k=5, seed=11, drop_prob=0.03)
+    _diff(cfg, 48, chunks=(16, 16, 16))
+
+
+def test_unsupported_config_raises():
+    for bad in (RaftConfig(prevote=True),
+                RaftConfig(reconfig_prob=0.5),
+                RaftConfig(transfer_prob=0.5),
+                RaftConfig(read_every=4)):
+        assert not pkernel.supported(bad)
+        with pytest.raises(ValueError):
+            pkernel.prun(bad, state.init(bad, n_groups=4), 4,
+                         interpret=True)
+
+
+def test_kstate_round_trip():
+    """kinit -> kfinish with zero ticks is the identity on State."""
+    cfg = RaftConfig(n_groups=10, k=4, seed=3)
+    st0 = state.init(cfg)
+    leaves, g = pkernel.kinit(cfg, st0)
+    st1, met = pkernel.kfinish(cfg, leaves, g)
+    assert trees_equal(st0, st1)
+    assert pkernel.kcommitted(leaves, g) == 0
+    assert pkernel.kelections(leaves, g) == 0
