@@ -91,9 +91,23 @@ def _col(n: int):
 
 
 def _lget(arr, idx):
-    """arr[idx] over the leading axis via one-hot reduce:
-    [N,8,128],[8,128] -> [8,128]."""
-    return jnp.sum(jnp.where(_col(arr.shape[0]) == idx, arr, 0), axis=0)
+    """arr[idx] over the leading axis: [N,8,128],[8,128] -> [8,128].
+
+    Bit-tree select, NOT the XLA path's one-hot reduce: selecting by
+    the bits of `idx` costs N-1 selects + log2(N) bit tests (~36 vreg
+    ops at N=32) where compare+select+sum costs ~3N (~95). At a
+    non-power-of-two N, an unpaired row pairs with itself, so
+    out-of-range high bits of idx resolve to SOME in-range row —
+    unreachable anyway, since callers guarantee 0 <= idx < N. i32 only
+    (vector-bool selects do not lower; module docstring)."""
+    rows = [arr[j] for j in range(arr.shape[0])]
+    nbits = max(1, (arr.shape[0] - 1).bit_length())
+    for b in range(nbits):
+        bit = ((idx >> b) & 1) == 1
+        rows = [jnp.where(bit, rows[j + 1] if j + 1 < len(rows) else rows[j],
+                          rows[j])
+                for j in range(0, len(rows), 2)]
+    return rows[0]
 
 
 def _lset(arr, idx, cond, val):

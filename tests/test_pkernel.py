@@ -49,10 +49,20 @@ def _diff(cfg, n_ticks, chunks=None):
     return stp
 
 
+@pytest.mark.slow
 def test_headline_config_bit_exact():
     """The bench headline shape (fault-free, k=5, L=32) in miniature,
-    including the pad path (12 groups -> one 1024-group block)."""
-    _diff(RaftConfig(n_groups=12, seed=42), 48)
+    including the pad path (12 groups -> one 1024-group block). Slow
+    tier: the L=32 interpret-mode compile is minutes on CPU; the fast
+    tier covers the same program at L=8 below, and bench.py's in-run
+    full-shape differential covers L=32 on the real TPU."""
+    _diff(RaftConfig(n_groups=12, seed=42), 32)
+
+
+def test_headline_config_small_window():
+    """The headline program shape at a small ring (k=5, L=8), incl. the
+    pad path (12 groups -> one 1024-group block)."""
+    _diff(RaftConfig(n_groups=12, seed=42, log_cap=8, compact_every=4), 32)
 
 
 def test_fault_mix_bit_exact():
@@ -60,7 +70,8 @@ def test_fault_mix_bit_exact():
     — with restarts exercising _apply_restart and mailbox filtering."""
     cfg = RaftConfig(n_groups=16, k=3, seed=7, drop_prob=0.05,
                      crash_prob=0.1, crash_epoch=16,
-                     partition_prob=0.2, partition_epoch=16)
+                     partition_prob=0.2, partition_epoch=16,
+                     log_cap=8, compact_every=4)
     _diff(cfg, 56)
 
 
@@ -68,7 +79,8 @@ def test_chunked_resume_matches_single_run():
     """kstep chunk boundaries are invisible: 3 launches == one 48-tick
     run, bit-exact (the carry widens/narrows bools across the fori_loop
     AND the launch boundary — both must round-trip)."""
-    cfg = RaftConfig(n_groups=8, k=5, seed=11, drop_prob=0.03)
+    cfg = RaftConfig(n_groups=8, k=3, seed=11, drop_prob=0.03,
+                     log_cap=8, compact_every=4)
     _diff(cfg, 48, chunks=(16, 16, 16))
 
 
