@@ -19,6 +19,25 @@ _U32 = 0xFFFFFFFF
 CONFIG_FLAG = 1 << 30
 PAYLOAD_MASK = CONFIG_FLAG - 1
 
+# Client-session encoding (exactly-once application, dissertation §6.3;
+# active only when `RaftConfig.sessions`). A set SESSION_FLAG bit (below
+# CONFIG_FLAG) marks a session command: sid in bits 20-28 (sid 0x1FF
+# reserved = session REGISTER), client sequence number in bits 10-19,
+# 10-bit value hash in bits 0-9. The state machine applies a (sid, seq)
+# at most once — retried proposals commit as duplicate log entries but
+# fold into the digest exactly once on every node.
+SESSION_FLAG = 1 << 29
+SESSION_SID_SHIFT, SESSION_SID_MASK = 20, 0x1FF
+SESSION_SEQ_SHIFT, SESSION_SEQ_MASK = 10, 0x3FF
+SESSION_VAL_MASK = 0x3FF
+SESSION_REGISTER = SESSION_FLAG | (SESSION_SID_MASK << SESSION_SID_SHIFT)
+
+
+def session_payload(sid: int, seq: int, val: int) -> int:
+    assert 0 <= sid < SESSION_SID_MASK and 0 <= seq <= SESSION_SEQ_MASK
+    return (SESSION_FLAG | (sid << SESSION_SID_SHIFT)
+            | (seq << SESSION_SEQ_SHIFT) | (val & SESSION_VAL_MASK))
+
 
 def _prob_to_u32(p: float) -> int:
     """Map a probability to a uint32 threshold: event iff hash < threshold.
@@ -45,6 +64,14 @@ class RaftConfig:
     election_range: int = 10   # [election_min, election_min + election_range)
     compact_every: int = 8     # snapshot when commit - snap_index >= this
     cmds_per_tick: int = 1     # client commands the leader appends per tick
+    # Client sessions (exactly-once application, dissertation §6.3) —
+    # CPU-oracle client feature; the session bit-fields above become
+    # meaningful to the state machine only when True. Interactive
+    # `propose` payloads must then keep bit 29 clear (asserted); the
+    # scheduled batched workload hashes the full 30-bit space, so
+    # sessions=True is for interactive-client universes
+    # (cmds_per_tick=0), not scheduled ones.
+    sessions: bool = False
     seed: int = 0
 
     # Fault injection (DESIGN.md §4). All off by default.
@@ -91,6 +118,10 @@ class RaftConfig:
     prevote: bool = False
 
     def __post_init__(self):
+        assert not self.sessions or self.cmds_per_tick == 0, (
+            "sessions=True needs cmds_per_tick=0: scheduled payloads hash "
+            "the full 30-bit space, so bit 29 would be misread as session "
+            "commands (see the sessions field comment)")
         assert self.k >= 1
         assert self.election_range >= 1
         assert self.heartbeat_every >= 1

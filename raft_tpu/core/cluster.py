@@ -9,6 +9,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
+from raft_tpu import config as _c
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.node import Node, LEADER
 from raft_tpu.core.transport import Transport
@@ -58,6 +59,12 @@ class Cluster:
         # the term of an entry may legitimately be rewritten by a leader
         # takeover re-proposal (DESIGN.md §2a) without changing the entry.
         self._committed: Dict[int, int] = {}
+        # Shadow of the state machine's session-allocation rule (first
+        # REGISTER to claim an sid owns it), maintained from the same
+        # first-application events as the commit-identity map — lets
+        # `open_session` tell a successful registration from a no-op
+        # collision without peeking at server state mid-protocol.
+        self._session_owner: Dict[int, int] = {}
         self.total_applies = 0
 
     # ---------------------------------------------------------------- faults
@@ -79,6 +86,10 @@ class Cluster:
         prev = self._committed.get(index)
         if prev is None:
             self._committed[index] = payload
+            if self.cfg.sessions:
+                if payload == _c.SESSION_REGISTER:
+                    self._session_owner.setdefault(
+                        index % _c.SESSION_SID_MASK, index)
         elif prev != payload:
             raise SafetyViolation(
                 f"group {self.g}: node {node_id} applied payload {payload} at "
@@ -153,6 +164,40 @@ class Cluster:
         idx, payload = ticket
         return self._committed.get(idx) == payload
 
+    def open_session(self, max_ticks: int = 200):
+        """Register a client session (dissertation §6.3): propose the
+        REGISTER entry, tick until it commits, and return the
+        index-derived session id (or None if it never committed — the
+        takeover re-proposal never displaces entries, so the only
+        failure is a lost ticket; callers retry). cfg.sessions only."""
+        ticket = None
+        for _ in range(max_ticks):
+            if ticket is None:
+                lead = self.leader()
+                if lead is not None:
+                    idx = self.nodes[lead].propose_register()
+                    if idx is not None:
+                        ticket = (idx, _c.SESSION_REGISTER)
+            if ticket is not None and self.is_committed(ticket):
+                sid = ticket[0] % _c.SESSION_SID_MASK
+                if self._session_owner.get(sid) == ticket[0]:
+                    return sid
+                ticket = None            # collision no-op: re-register
+            self.tick()
+        return None
+
+    def propose_seq(self, sid: int, seq: int, val: int):
+        """Route an exactly-once session write to the current leader.
+        Returns the (index, payload) ticket or None (retry — safely:
+        duplicates fold once)."""
+        lead = self.leader()
+        if lead is None:
+            return None
+        idx = self.nodes[lead].propose_seq(sid, seq, val)
+        if idx is None:
+            return None
+        return (idx, self.nodes[lead].payload_at(idx))
+
     def propose_reconfig(self, new_mask: int):
         """Route a single-server membership change to the current leader.
         Returns the (index, payload) ticket or None."""
@@ -204,10 +249,28 @@ class Cluster:
     def expected_digest(self, through_index: int) -> int:
         """Replay the commit-identity map's hash chain through
         `through_index` — the value any node's digest must hold after
-        applying exactly that prefix (read-your-writes checker)."""
+        applying exactly that prefix (read-your-writes checker). With
+        cfg.sessions, the replay applies the same exactly-once filter
+        as `Node._session_effective` (tests/test_sessions.py carries an
+        independent re-implementation as the oracle-of-this-oracle)."""
         d = 0
+        sessions: Dict[int, int] = {}
         for i in range(1, through_index + 1):
-            d = rng.digest_update(d, i, self._committed[i])
+            p = self._committed[i]
+            if (self.cfg.sessions and p & _c.SESSION_FLAG
+                    and not p & _c.CONFIG_FLAG):
+                sid = (p >> _c.SESSION_SID_SHIFT) & _c.SESSION_SID_MASK
+                if sid == _c.SESSION_SID_MASK:
+                    new_sid = i % _c.SESSION_SID_MASK
+                    if new_sid in sessions:
+                        continue
+                    sessions[new_sid] = -1
+                else:
+                    seq = (p >> _c.SESSION_SEQ_SHIFT) & _c.SESSION_SEQ_MASK
+                    if sid not in sessions or seq <= sessions[sid]:
+                        continue
+                    sessions[sid] = seq
+            d = rng.digest_update(d, i, p)
         return d
 
     # ------------------------------------------------------------- observers

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional
 
+from raft_tpu import config
 from raft_tpu.config import CONFIG_FLAG, RaftConfig
 from raft_tpu.core import rpc
 from raft_tpu.utils import rng
@@ -46,6 +47,9 @@ class Node:
         self.snap_term = 0
         self.snap_digest = 0
         self.snap_voters = cfg.full_mask  # voter mask as of the snapshot prefix
+        # Session table as of the snapshot prefix (cfg.sessions only):
+        # sid -> last applied client seq. Durable with the snapshot.
+        self.snap_sessions: dict = {}
         self.rng_draws = 0           # monotone deadline-draw counter
 
         # Volatile state (reset on restart).
@@ -54,6 +58,10 @@ class Node:
         self.commit = 0
         self.applied = 0
         self.digest = 0
+        # Live session table (exactly-once, dissertation §6.3): pure
+        # state-machine state — rebuilt from snap_sessions + re-apply
+        # on restart, exactly like `digest`.
+        self.sessions: dict = dict(self.snap_sessions)
         self.votes = [False] * cfg.k
         self.next_index = [1] * cfg.k
         self.match_index = [0] * cfg.k
@@ -243,6 +251,7 @@ class Node:
         self.commit = self.snap_index
         self.applied = self.snap_index
         self.digest = self.snap_digest
+        self.sessions = dict(self.snap_sessions)
         self.votes = [False] * self.cfg.k
         self.next_index = [1] * self.cfg.k
         self.match_index = [0] * self.cfg.k
@@ -411,9 +420,11 @@ class Node:
         self.snap_term = m.snap_term
         self.snap_digest = m.snap_digest
         self.snap_voters = m.snap_voters
+        self.snap_sessions = dict(m.snap_sessions or ())
         self.commit = m.snap_index
         self.applied = m.snap_index
         self.digest = m.snap_digest
+        self.sessions = dict(self.snap_sessions)
         self.transport.send(rpc.InstallSnapshotResp(
             rpc.IS_RESP, self.id, m.src, term=self.term, match=m.snap_index))
 
@@ -478,6 +489,32 @@ class Node:
 
     # ------------------------------------------------------------- client API
 
+    def _session_effective(self, index: int, payload: int) -> bool:
+        """Exactly-once filter (dissertation §6.3), applied at digest-fold
+        time so every node makes the identical decision from the same
+        committed prefix. Returns False iff the entry is a session
+        command whose effect must be skipped: a duplicate (sid, seq)
+        retry, a command on an unregistered session, or a REGISTER whose
+        index-derived sid is already taken. With cfg.sessions off (every
+        scheduled universe), every entry is effective — bit-identical to
+        the pre-session digest stream."""
+        if not self.cfg.sessions:
+            return True
+        if payload & config.CONFIG_FLAG or not payload & config.SESSION_FLAG:
+            return True
+        sid = (payload >> config.SESSION_SID_SHIFT) & config.SESSION_SID_MASK
+        if sid == config.SESSION_SID_MASK:          # REGISTER
+            new_sid = index % config.SESSION_SID_MASK
+            if new_sid in self.sessions:
+                return False
+            self.sessions[new_sid] = -1
+            return True
+        seq = (payload >> config.SESSION_SEQ_SHIFT) & config.SESSION_SEQ_MASK
+        if sid not in self.sessions or seq <= self.sessions[sid]:
+            return False
+        self.sessions[sid] = seq
+        return True
+
     def propose(self, payload: int):
         """Client write: append `payload` under the current term.
 
@@ -490,7 +527,39 @@ class Node:
         """
         if self.role != LEADER:
             return None
+        if self.cfg.sessions:
+            # Bits 29-30 are protocol-reserved when sessions are on: a
+            # raw payload carrying them would be (mis)read by the state
+            # machine as a session/config command. Session commands go
+            # through `propose_seq`.
+            if payload & (CONFIG_FLAG | config.SESSION_FLAG):
+                raise ValueError("payload uses reserved session/config bits; "
+                                 "use propose_seq/propose_config")
         if not self._append(self.term, payload):
+            return None
+        return self.last_index
+
+    def propose_register(self):
+        """Propose a session REGISTER entry (cfg.sessions). On apply,
+        the state machine allocates sid = index % SESSION_SID_MASK (a
+        taken sid makes the registration a deterministic no-op — the
+        client retries). Returns the index or None."""
+        if self.role != LEADER or not self.cfg.sessions:
+            return None
+        if not self._append(self.term, config.SESSION_REGISTER):
+            return None
+        return self.last_index
+
+    def propose_seq(self, sid: int, seq: int, val: int):
+        """Client write with exactly-once semantics (cfg.sessions): the
+        state machine applies (sid, seq) at most once, so a client that
+        RETRIES after an ambiguous failure (leader deposed with the
+        ticket unresolved) cannot double-apply. Returns the index or
+        None (not leader / window full). `sid` comes from a committed
+        REGISTER entry (Cluster.open_session)."""
+        if self.role != LEADER or not self.cfg.sessions:
+            return None
+        if not self._append(self.term, config.session_payload(sid, seq, val)):
             return None
         return self.last_index
 
@@ -632,7 +701,9 @@ class Node:
                     rpc.IS_REQ, self.id, p, term=self.term,
                     snap_index=self.snap_index, snap_term=self.snap_term,
                     snap_digest=self.snap_digest,
-                    snap_voters=self.snap_voters))
+                    snap_voters=self.snap_voters,
+                    snap_sessions=(tuple(sorted(self.snap_sessions.items()))
+                                   if self.cfg.sessions else None)))
             else:
                 prev = self.next_index[p] - 1
                 n = min(self.cfg.max_entries_per_msg, self.last_index - prev)
@@ -747,11 +818,13 @@ class Node:
         while self.applied < self.commit:
             self.applied += 1
             t, p = self.log[self.applied - self.snap_index - 1]
-            self.digest = rng.digest_update(self.digest, self.applied, p)
+            if self._session_effective(self.applied, p):
+                self.digest = rng.digest_update(self.digest, self.applied, p)
             if self.on_apply is not None:
                 self.on_apply(self.id, self.applied, t, p)
         if self.commit - self.snap_index >= self.cfg.compact_every:
             self.snap_voters = self.committed_config()
+            self.snap_sessions = dict(self.sessions)
             self.snap_term = self.term_at(self.commit)
             self.log = self.log[self.commit - self.snap_index:]
             self.snap_index = self.commit

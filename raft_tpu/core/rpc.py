@@ -63,6 +63,9 @@ class InstallSnapshotReq(Msg):
     snap_term: int = 0
     snap_digest: int = 0
     snap_voters: int = 0   # voter bitmask as of the snapshot prefix
+    # Session table as of the snapshot prefix (sid -> last applied seq);
+    # None unless cfg.sessions (the batched path never carries it).
+    snap_sessions: tuple = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,181 @@
+"""Client sessions / exactly-once application (dissertation §6.3).
+
+CPU-oracle client feature (`cfg.sessions`): retried proposals commit as
+duplicate log entries, but the state machine folds each (sid, seq) into
+the digest exactly once on every node — so an ambiguous-failure retry
+can never double-apply. The scheduled/batched universes never set
+`sessions`, and `test_sessions_off_is_inert` pins that the flag's
+absence leaves the digest stream untouched.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from raft_tpu import config as C
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.cluster import Cluster
+from raft_tpu.utils import rng
+
+
+def _scfg(**kw):
+    kw.setdefault("k", 3)
+    kw.setdefault("sessions", True)
+    kw.setdefault("cmds_per_tick", 0)   # interactive clients only
+    return RaftConfig(**kw)
+
+
+def _settle(c: Cluster, ticket, max_ticks=100):
+    for _ in range(max_ticks):
+        if ticket is not None and c.is_committed(ticket):
+            return True
+        c.tick()
+    return False
+
+
+def _expected_digest(entries):
+    """Replay the session rule over committed (index, payload) pairs."""
+    digest, sessions = 0, {}
+    for index, payload in entries:
+        if payload & C.SESSION_FLAG and not payload & C.CONFIG_FLAG:
+            sid = (payload >> C.SESSION_SID_SHIFT) & C.SESSION_SID_MASK
+            if sid == C.SESSION_SID_MASK:
+                new_sid = index % C.SESSION_SID_MASK
+                if new_sid in sessions:
+                    continue
+                sessions[new_sid] = -1
+            else:
+                seq = (payload >> C.SESSION_SEQ_SHIFT) & C.SESSION_SEQ_MASK
+                if sid not in sessions or seq <= sessions[sid]:
+                    continue
+                sessions[sid] = seq
+        digest = rng.digest_update(digest, index, payload)
+    return digest
+
+
+def test_duplicate_retry_folds_once():
+    """The core exactly-once property: the same (sid, seq) proposed
+    twice commits twice but applies once; digest matches a replay that
+    skips the duplicate."""
+    c = Cluster(_scfg(seed=21))
+    c.run(40)
+    sid = c.open_session()
+    assert sid is not None
+    t1 = c.propose_seq(sid, 1, 0x155)
+    assert _settle(c, t1)
+    t2 = c.propose_seq(sid, 1, 0x155)       # client retry, same command
+    assert _settle(c, t2)
+    t3 = c.propose_seq(sid, 2, 0x2AA)       # next command still applies
+    assert _settle(c, t3)
+    c.run(20)
+    lead = c.nodes[c.leader()]
+    committed = sorted(c._committed.items())
+    assert lead.digest == _expected_digest(committed)
+    assert lead.sessions[sid] == 2
+    # the duplicate entry really is in the committed log (not elided)
+    assert sum(1 for _, p in committed if p == t1[1]) == 2
+
+
+def test_stale_and_unknown_session_skipped():
+    c = Cluster(_scfg(seed=22))
+    c.run(40)
+    sid = c.open_session()
+    t = c.propose_seq(sid, 5, 0x0AB)
+    assert _settle(c, t)
+    lead = c.nodes[c.leader()]
+    d0 = lead.digest
+    # stale seq: commits, but digest must not move past the replay
+    t2 = c.propose_seq(sid, 4, 0x0CD)
+    assert _settle(c, t2)
+    c.run(5)
+    assert c.nodes[c.leader()].digest == d0
+    # unknown sid: also a deterministic no-op
+    ghost = (sid + 1) % (C.SESSION_SID_MASK - 1)
+    t3 = c.propose_seq(ghost, 1, 0x0EF)
+    assert _settle(c, t3)
+    c.run(5)
+    assert c.nodes[c.leader()].digest == d0
+
+
+def test_retry_across_leader_change():
+    """The motivating scenario: propose, depose the leader before the
+    client learns the outcome, retry on the new leader — applied once.
+    Uses the crash-schedule override to force the leadership change."""
+    c = Cluster(_scfg(seed=23, k=3))
+    c.run(40)
+    sid = c.open_session()
+    old = c.leader()
+    t1 = c.propose_seq(sid, 1, 0x111)
+    assert t1 is not None
+    # run just enough for replication, then crash the leader
+    c.run(4)
+    down_from = c.tick_count
+    c.alive_fn = lambda t: [i != old for i in range(3)] \
+        if t < down_from + 60 else [True] * 3
+    # client never saw the ack: retry on the new leader until committed
+    for _ in range(200):
+        if c.is_committed(t1):
+            break
+        t_retry = c.propose_seq(sid, 1, 0x111)
+        if t_retry is not None and _settle(c, t_retry, 60):
+            break
+        c.tick()
+    c.alive_fn = None
+    c.run(80)   # heal: old leader catches back up
+    committed = sorted(c._committed.items())
+    for n in c.nodes:
+        if n.applied == max(i for i, _ in committed):
+            assert n.digest == _expected_digest(committed)
+        assert n.sessions.get(sid, 0) == 1 or n.applied < t1[0]
+
+
+def test_session_table_survives_snapshot_install():
+    """Dedup state rides InstallSnapshot: a node that was down across
+    the duplicate window is repaired from a snapshot whose table
+    already holds the (sid, seq) — the replayed duplicate must not
+    fold. compact_every is small so compaction is easy to force."""
+    c = Cluster(_scfg(seed=24, k=3, compact_every=4, log_cap=16))
+    c.run(40)
+    sid = c.open_session()
+    t1 = c.propose_seq(sid, 1, 0x3A)
+    assert _settle(c, t1)
+    victim = (c.leader() + 1) % 3
+    down_from = c.tick_count
+    c.alive_fn = lambda t: [i != victim for i in range(3)] \
+        if t < down_from + 80 else [True] * 3
+    # duplicate + enough filler to compact the window past it
+    t2 = c.propose_seq(sid, 1, 0x3A)
+    assert _settle(c, t2)
+    for j in range(20):
+        tk = c.propose_seq(sid, 2 + j, j)
+        assert _settle(c, tk)
+    c.alive_fn = None
+    c.run(120)  # victim restarts, gets InstallSnapshot, catches up
+    committed = sorted(c._committed.items())
+    top = max(i for i, _ in committed)
+    want = _expected_digest(committed)
+    repaired = c.nodes[victim]
+    assert repaired.snap_index > t2[0], "snapshot did not cover the dup"
+    assert repaired.applied == top and repaired.digest == want
+    assert repaired.sessions[sid] == 21
+
+
+def test_sessions_off_is_inert_and_guarded():
+    """sessions=False: a payload that happens to carry bit 29 folds like
+    any other (the scheduled workloads' digest streams are untouched).
+    sessions=True: raw propose() with reserved bits is rejected."""
+    c = Cluster(RaftConfig(k=3, seed=25, cmds_per_tick=0))
+    c.run(40)
+    p = C.SESSION_FLAG | 0x123
+    t = c.propose(p)
+    assert _settle(c, t)
+    lead = c.nodes[c.leader()]
+    d = 0   # plain fold of every committed entry — no session skipping
+    for index, payload in sorted(c._committed.items()):
+        d = rng.digest_update(d, index, payload)
+    assert lead.digest == d
+
+    cs = Cluster(_scfg(seed=26))
+    cs.run(40)
+    with pytest.raises(ValueError):
+        cs.nodes[cs.leader()].propose(C.SESSION_FLAG | 1)
