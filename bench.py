@@ -77,6 +77,60 @@ def _timed_chunks(cfg, n_groups: int, ticks: int, counter_fn,
     return delta / elapsed, delta, elapsed, n_chunks * CHUNK, st, m
 
 
+def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_fn,
+                    check_fn, st_ref, m_ref, what: str):
+    """Shared Pallas fused-chunk warmup/timing/differential harness
+    (the kernel-side analogue of `_timed_chunks`; bench_throughput and
+    bench_reads both run through here so the subtleties stay in ONE
+    place). Returns (rate, count, elapsed, status) with status one of
+    "ok" | "mismatch" | "unsupported" | an error string.
+
+    Subtleties encoded here, each learned from a wrong measurement:
+    - TWO warmup launches: the first compiles for kinit's buffer
+      layouts, the second for the kernel's own output layouts (a
+      distinct executable — timing it once cost 13.5s of "steady
+      state"); the counter fetch after each forces completion.
+    - The timed region is closed by the counter fetch itself: the TPU
+      tunnel's block_until_ready is not a reliable barrier.
+    - The differential extends the XLA reference (already at tick
+      CHUNK + timed_ticks from `_timed_chunks`) by ONE more chunk to
+      the kernel's 2*CHUNK + timed_ticks endpoint, then `check_fn`
+      must find the two universes bit-identical.
+    """
+    from raft_tpu.sim import pkernel
+    if not (pkernel.supported(cfg) and jax.devices()[0].platform == "tpu"):
+        return None, None, None, "unsupported"
+    try:
+        leaves, g = pkernel.kinit(cfg, sim.init(cfg, n_groups=n_groups))
+        t0 = time.perf_counter()
+        leaves = pkernel.kstep(cfg, leaves, 0, CHUNK)
+        counter_fn(leaves, g)                            # forces compile #1
+        leaves = pkernel.kstep(cfg, leaves, CHUNK, CHUNK)
+        base = counter_fn(leaves, g)                     # forces compile #2
+        log(f"  [pallas] warmup {2 * CHUNK} ticks (incl. 2 compiles): "
+            f"{time.perf_counter() - t0:.1f}s")
+        n_chunks = timed_ticks // CHUNK
+        start = time.perf_counter()
+        for c in range(n_chunks):
+            leaves = pkernel.kstep(cfg, leaves, (c + 2) * CHUNK, CHUNK)
+        count = counter_fn(leaves, g) - base    # fetch closes the timer
+        elapsed = time.perf_counter() - start
+        rate = count / elapsed
+        log(f"  [pallas] {n_groups} groups x {timed_ticks} ticks: "
+            f"{count} {what} in {elapsed:.2f}s -> {rate:,.0f} {what}/s")
+        st_ref, m_ref = sim.run(cfg, st_ref, CHUNK,
+                                CHUNK + timed_ticks, m_ref)
+        st_pal, m_pal = pkernel.kfinish(cfg, leaves, g)
+        if check_fn(st_ref, m_ref, st_pal, m_pal):
+            log("  [pallas] differential vs xla at same tick: bit-identical")
+            return rate, count, elapsed, "ok"
+        log("  [pallas] DIFFERENTIAL MISMATCH - kernel number discarded")
+        return None, None, None, "mismatch"
+    except Exception as e:   # kernel failure must never kill the bench
+        log(f"  [pallas] failed ({type(e).__name__}: {e}); xla stands")
+        return None, None, None, f"error: {type(e).__name__}"
+
+
 def bench_throughput(n_groups: int, ticks: int):
     """Config 2/3/5 shape: steady-state replication throughput.
 
@@ -96,60 +150,18 @@ def bench_throughput(n_groups: int, ticks: int):
         f"in {elapsed:.2f}s -> {rps:,.0f} rounds/s "
         f"({timed_ticks / elapsed:,.0f} ticks/s)")
     engine = "xla-scan"
-    pallas_rps = None
-
-    try:   # kernel failure of ANY kind (incl. import) never kills the bench
-        from raft_tpu.sim import pkernel
-        if pkernel.supported(cfg) and jax.devices()[0].platform == "tpu":
-            # TWO warmup launches: the first compiles for kinit's
-            # buffer layouts, the second for the kernel's own output
-            # layouts (a distinct executable — timing it once cost 13.5s
-            # of "steady state"). The timed region then measures only
-            # real launches, closed by the counter fetch itself (the
-            # tunnel's block_until_ready is not a reliable barrier).
-            leaves, g = pkernel.kinit(cfg, sim.init(cfg, n_groups=n_groups))
-            t0 = time.perf_counter()
-            leaves = pkernel.kstep(cfg, leaves, 0, CHUNK)
-            base = pkernel.kcommitted(leaves, g)            # forces #1
-            leaves = pkernel.kstep(cfg, leaves, CHUNK, CHUNK)
-            base = pkernel.kcommitted(leaves, g)            # forces #2
-            log(f"  [pallas] warmup {2 * CHUNK} ticks (incl. 2 compiles): "
-                f"{time.perf_counter() - t0:.1f}s")
-            n_chunks = timed_ticks // CHUNK
-            start = time.perf_counter()
-            for c in range(n_chunks):
-                leaves = pkernel.kstep(cfg, leaves, (c + 2) * CHUNK, CHUNK)
-            p_end = pkernel.kcommitted(leaves, g)   # fetch closes the timer
-            p_elapsed = time.perf_counter() - start
-            p_rounds = p_end - base
-            pallas_rps = p_rounds / p_elapsed
-            log(f"  [pallas] {n_groups} groups x {timed_ticks} ticks: "
-                f"{p_rounds} rounds in {p_elapsed:.2f}s -> "
-                f"{pallas_rps:,.0f} rounds/s "
-                f"({timed_ticks / p_elapsed:,.0f} ticks/s)")
-            # Differential: the same universe on the XLA path to the
-            # same tick, reusing _timed_chunks' final state (already at
-            # tick CHUNK + timed_ticks) — one more chunk reaches the
-            # kernel's 2*CHUNK + timed_ticks endpoint.
-            st_ref, m_ref = sim.run(cfg, st_ref, CHUNK,
-                                    CHUNK + timed_ticks, m_ref)
-            _, m_pal = pkernel.kfinish(cfg, leaves, g)
-            if np.array_equal(np.asarray(m_ref.committed),
-                              np.asarray(m_pal.committed)):
-                if pallas_rps > rps:
-                    rps, rounds, elapsed = pallas_rps, p_rounds, p_elapsed
-                    engine = "pallas-fused-chunk"
-                log("  [pallas] differential vs xla at same tick: "
-                    "bit-identical committed vector")
-            else:
-                log("  [pallas] DIFFERENTIAL MISMATCH - kernel number "
-                    "discarded, xla headline stands")
-                engine = "xla-scan (pallas mismatch!)"
-                pallas_rps = None   # never report a rate that failed it
-    except Exception as e:
-        pallas_rps = None           # a rate that never passed the differential
-        log(f"  [pallas] failed ({type(e).__name__}: {e}); "
-            f"xla headline stands")
+    from raft_tpu.sim import pkernel
+    p_rate, p_count, p_elapsed, status = _pallas_segment(
+        cfg, n_groups, timed_ticks, pkernel.kcommitted,
+        lambda sr, mr, sp, mp: np.array_equal(np.asarray(mr.committed),
+                                              np.asarray(mp.committed)),
+        st_ref, m_ref, "rounds")
+    if status == "ok" and p_rate > rps:
+        rps, rounds, elapsed = p_rate, p_count, p_elapsed
+        engine = "pallas-fused-chunk"
+    elif status == "mismatch":
+        engine = "xla-scan (pallas mismatch!)"
+    pallas_rps = p_rate if status == "ok" else None
     return rps, rounds, elapsed, timed_ticks, engine, pallas_rps
 
 
@@ -214,16 +226,37 @@ def bench_reads(n_groups: int, ticks: int):
     config-5 replication workload with the ReadIndex pipeline on
     (read_every=4). Completed reads are counted from the `reads_done`
     trace field — with no fault schedule the counter is monotone (no
-    restarts zero it), so the timed delta is exact."""
+    restarts zero it), so the timed delta is exact. Same two-engine
+    scheme as the headline: the Pallas fused-chunk number is promoted
+    only when BOTH the per-group committed vector and the per-node
+    reads_done counters are bit-identical to the XLA path at the same
+    tick."""
     cfg = RaftConfig(seed=45, read_every=4)
-    rps, reads, elapsed, timed_ticks, _, _ = _timed_chunks(
+    rps, reads, elapsed, timed_ticks, st_ref, m_ref = _timed_chunks(
         cfg, n_groups, ticks,
         lambda st, m: int(np.asarray(st.nodes.reads_done)
                           .astype(np.int64).sum()))
-    log(f"  linearizable reads {n_groups} groups x {timed_ticks} "
+    log(f"  [xla] linearizable reads {n_groups} groups x {timed_ticks} "
         f"ticks (read_every={cfg.read_every}): {reads} reads in "
         f"{elapsed:.2f}s -> {rps:,.0f} reads/s")
-    return rps, reads
+    engine = "xla-scan"
+    from raft_tpu.sim import pkernel
+
+    def same(sr, mr, sp, mp):
+        return (np.array_equal(np.asarray(mr.committed),
+                               np.asarray(mp.committed))
+                and np.array_equal(np.asarray(sr.nodes.reads_done),
+                                   np.asarray(sp.nodes.reads_done)))
+
+    p_rate, p_count, _, status = _pallas_segment(
+        cfg, n_groups, timed_ticks, pkernel.kreads, same,
+        st_ref, m_ref, "reads")
+    if status == "ok" and p_rate > rps:
+        rps, reads = p_rate, p_count
+        engine = "pallas-fused-chunk"
+    elif status == "mismatch":
+        engine = "xla-scan (pallas mismatch!)"
+    return rps, reads, engine
 
 
 def main():
@@ -264,7 +297,7 @@ def main():
     log("election rounds (config-2 shape):")
     eps, n_c2_elections = bench_election_rounds(r_groups, r_ticks)
     log("linearizable reads (config-5 shape + ReadIndex schedule):")
-    reads_ps, n_reads = bench_reads(rd_groups, rd_ticks)
+    reads_ps, n_reads, reads_engine = bench_reads(rd_groups, rd_ticks)
 
     print(json.dumps({
         "metric": "consensus_rounds_per_sec_per_chip",
@@ -288,6 +321,7 @@ def main():
         "config2_note": "schedule-bound rate; see bench_election_rounds",
         "linearizable_reads_per_sec": round(reads_ps, 1),
         "reads_observed": n_reads,
+        "reads_engine": reads_engine,
         "device": f"{dev.platform}:{dev.device_kind}",
     }))
 

@@ -77,9 +77,11 @@ GB = SUB * LANE   # groups per block (1024): ~5 MB of VMEM state/block
 
 
 def supported(cfg: RaftConfig) -> bool:
-    """The statically-specialized subset this kernel implements."""
+    """The statically-specialized subset this kernel implements: the
+    fault classes and the scheduled-read pipeline; reconfig / prevote /
+    transfer stay on the XLA path."""
     return (cfg.reconfig_u32 == 0 and not cfg.prevote
-            and cfg.transfer_u32 == 0 and cfg.read_every == 0)
+            and cfg.transfer_u32 == 0)
 
 
 # ----------------------------------------------------------- small helpers
@@ -195,17 +197,29 @@ def _reset_timer(cfg, ns: PerNode, g, i, cond):
     )
 
 
-def _step_down(cfg, ns: PerNode, new_term, cond):
+def _drop_reads(cfg, ns: PerNode, cond):
+    """step._drop_reads: statically absent when the schedule is off."""
+    if not cfg.read_every:
+        return ns
     return ns._replace(
+        ack_time=jnp.where(cond, -1, ns.ack_time),
+        sched_read_index=jnp.where(cond, -1, ns.sched_read_index),
+    )
+
+
+def _step_down(cfg, ns: PerNode, new_term, cond):
+    ns = ns._replace(
         term=jnp.where(cond, new_term, ns.term),
         role=jnp.where(cond, FOLLOWER, ns.role),
         voted_for=jnp.where(cond, NO_VOTE, ns.voted_for),
         leader_id=jnp.where(cond, NO_VOTE, ns.leader_id),
         votes=ns.votes & ~cond,
     )
+    return _drop_reads(cfg, ns, cond)
 
 
 def _become_leader(cfg, ns: PerNode, i, cond):
+    ns = _drop_reads(cfg, ns, cond)
     ns = ns._replace(
         role=jnp.where(cond, LEADER, ns.role),
         leader_id=jnp.where(cond, i, ns.leader_id),
@@ -269,7 +283,7 @@ def _on_rv_resp(cfg, ns, out, g, i, src: int, ib, gl):
 
 def _on_ae_req(cfg, ns, out, g, i, src: int, ib, gl):
     """step._on_ae_req: receiver-pull log matching, decide-then-write."""
-    glog_t, glog_p = gl
+    glog_t, glog_p = gl[0], gl[1]
     present = ib.ae_req_present[src]
     m_term = ib.ae_req_term[src]
     m_prev = ib.ae_req_prev_index[src]
@@ -362,6 +376,11 @@ def _on_ae_resp(cfg, ns, out, g, i, src: int, ib, gl):
     higher = present & (m_term > ns.term)
     ns = _step_down(cfg, ns, m_term, higher)
     cont = present & ~higher & (ns.role == LEADER) & (m_term == ns.term)
+    if cfg.read_every:
+        # Any current-term response is ReadIndex deference evidence
+        # (step.py:379): stamp the arrival tick, success or not.
+        ns = ns._replace(ack_time=jnp.where(
+            (_col(cfg.k) == src) & cont, gl[2], ns.ack_time))
     succ = cont & m_success
     fail = cont & ~m_success
     old_match = ns.match_index[src]
@@ -421,6 +440,9 @@ def _on_is_resp(cfg, ns, out, g, i, src: int, ib, gl):
     higher = present & (m_term > ns.term)
     ns = _step_down(cfg, ns, m_term, higher)
     cont = present & ~higher & (ns.role == LEADER) & (m_term == ns.term)
+    if cfg.read_every:
+        ns = ns._replace(ack_time=jnp.where(
+            (_col(cfg.k) == src) & cont, gl[2], ns.ack_time))
     old_match = ns.match_index[src]
     new_match = jnp.maximum(old_match, m_match)
     kio = _col(cfg.k)
@@ -505,6 +527,19 @@ def _phase_t(cfg, ns, out, g, i, t):
 
 def _phase_c(cfg, ns, g, t):
     lead = ns.role == LEADER
+
+    if cfg.read_every:
+        # step._phase_c read registration: START of phase C, pre-append
+        # commit as the read point, gated like read_begin.
+        gate = ((ns.commit == ns.last_index)
+                | (_term_at(cfg, ns, ns.commit) == ns.term))
+        reg = (lead & ((t % cfg.read_every) == 0)
+               & (ns.sched_read_index < 0) & gate)
+        ns = ns._replace(
+            sched_read_index=jnp.where(reg, ns.commit, ns.sched_read_index),
+            sched_read_reg=jnp.where(reg, t, ns.sched_read_reg),
+        )
+
     last_index = ns.last_index
     log_term, log_payload = ns.log_term, ns.log_payload
     stopped = lead & (g < 0)                    # all-false, constant-free
@@ -538,12 +573,27 @@ def _phase_a(cfg, ns, i):
         applied = jnp.where(act, idx, applied)
 
     compact = (commit - ns.snap_index) >= cfg.compact_every
-    return ns._replace(
+    ns = ns._replace(
         commit=commit, applied=applied, digest=digest,
         snap_term=jnp.where(compact, _term_at(cfg, ns, commit), ns.snap_term),
         snap_index=jnp.where(compact, commit, ns.snap_index),
         snap_digest=jnp.where(compact, digest, ns.snap_digest),
     )
+    if cfg.read_every:
+        # Scheduled-read completion (step.py phase A end; reconfig is
+        # statically off in this kernel, so the quorum is the full-set
+        # majority and every lane is a voter).
+        sched = ns.sched_read_index >= 0
+        recent = ns.ack_time >= ns.sched_read_reg + 2
+        not_self = _col(cfg.k) != i
+        acks = jnp.sum((recent & not_self).astype(I32), axis=0)
+        done = (sched & (acks + 1 >= cfg.majority)
+                & (ns.applied >= ns.sched_read_index))
+        ns = ns._replace(
+            reads_done=ns.reads_done + done.astype(I32),
+            sched_read_index=jnp.where(done, -1, ns.sched_read_index),
+        )
+    return ns
 
 
 def _node_tick(cfg, t, ns: PerNode, inbox, g, i, glog_t, glog_p):
@@ -563,7 +613,7 @@ def _node_tick(cfg, t, ns: PerNode, inbox, g, i, glog_t, glog_p):
         is_req_term=zK, is_req_snap_index=zK, is_req_snap_term=zK,
         is_req_snap_digest=zKu, is_req_snap_voters=zK,
         is_resp_term=zK, is_resp_match=zK)
-    gl = (glog_t, glog_p)
+    gl = (glog_t, glog_p, t)
     for handler in _HANDLERS:
         for src in range(cfg.k):
             ns, out = handler(cfg, ns, out, g, i, src, inbox, gl)
@@ -949,6 +999,15 @@ def kcommitted(leaves, g: int) -> int:
     import numpy as np
     mc = np.asarray(_unfold_g(leaves[-N_METRIC_LEAVES]))[:g]
     return int(mc.astype(np.int64).sum())
+
+
+def kreads(leaves, g: int) -> int:
+    """Host-side total completed scheduled reads (sum of the per-node
+    `reads_done` counters), straight from the wire form."""
+    import numpy as np
+    idx = PerNode._fields.index("reads_done")
+    rd = np.asarray(_unfold_g(leaves[idx]))[..., :g]   # [K, g]
+    return int(rd.astype(np.int64).sum())
 
 
 def kelections(leaves, g: int) -> int:

@@ -75,6 +75,17 @@ def test_fault_mix_bit_exact():
     _diff(cfg, 56)
 
 
+def test_scheduled_reads_bit_exact():
+    """The ReadIndex pipeline in-kernel: registration (phase C), ack
+    stamping (ae/is responses), completion quorum (phase A), and the
+    step-down/become-leader read-drops — against the XLA path, with
+    drops forcing retries."""
+    cfg = RaftConfig(n_groups=12, k=3, seed=13, read_every=4,
+                     drop_prob=0.05, log_cap=8, compact_every=4)
+    stp = _diff(cfg, 48)
+    assert int(np.asarray(stp.nodes.reads_done).sum()) > 0
+
+
 def test_chunked_resume_matches_single_run():
     """kstep chunk boundaries are invisible: 3 launches == one 48-tick
     run, bit-exact (the carry widens/narrows bools across the fori_loop
@@ -87,8 +98,7 @@ def test_chunked_resume_matches_single_run():
 def test_unsupported_config_raises():
     for bad in (RaftConfig(prevote=True),
                 RaftConfig(reconfig_prob=0.5),
-                RaftConfig(transfer_prob=0.5),
-                RaftConfig(read_every=4)):
+                RaftConfig(transfer_prob=0.5)):
         assert not pkernel.supported(bad)
         with pytest.raises(ValueError):
             pkernel.prun(bad, state.init(bad, n_groups=4), 4,
