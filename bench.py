@@ -77,7 +77,7 @@ def _timed_chunks(cfg, n_groups: int, ticks: int, counter_fn,
     return delta / elapsed, delta, elapsed, n_chunks * CHUNK, st, m
 
 
-def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_fn,
+def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
                     check_fn, st_ref, m_ref, what: str):
     """Shared Pallas fused-chunk warmup/timing/differential harness
     (the kernel-side analogue of `_timed_chunks`; bench_throughput and
@@ -97,10 +97,12 @@ def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_fn,
       the kernel's 2*CHUNK + timed_ticks endpoint, then `check_fn`
       must find the two universes bit-identical.
     """
-    from raft_tpu.sim import pkernel
-    if not (pkernel.supported(cfg) and jax.devices()[0].platform == "tpu"):
-        return None, None, None, "unsupported"
-    try:
+    try:   # kernel failure of ANY kind (incl. import) never kills the bench
+        from raft_tpu.sim import pkernel
+        if not (pkernel.supported(cfg)
+                and jax.devices()[0].platform == "tpu"):
+            return None, None, None, "unsupported"
+        counter_fn = getattr(pkernel, counter_name)
         leaves, g = pkernel.kinit(cfg, sim.init(cfg, n_groups=n_groups))
         t0 = time.perf_counter()
         leaves = pkernel.kstep(cfg, leaves, 0, CHUNK)
@@ -150,9 +152,8 @@ def bench_throughput(n_groups: int, ticks: int):
         f"in {elapsed:.2f}s -> {rps:,.0f} rounds/s "
         f"({timed_ticks / elapsed:,.0f} ticks/s)")
     engine = "xla-scan"
-    from raft_tpu.sim import pkernel
     p_rate, p_count, p_elapsed, status = _pallas_segment(
-        cfg, n_groups, timed_ticks, pkernel.kcommitted,
+        cfg, n_groups, timed_ticks, "kcommitted",
         lambda sr, mr, sp, mp: np.array_equal(np.asarray(mr.committed),
                                               np.asarray(mp.committed)),
         st_ref, m_ref, "rounds")
@@ -214,11 +215,25 @@ def bench_election_rounds(n_groups: int, ticks: int):
     election count so under-sampling is visible)."""
     cfg = RaftConfig(seed=44, cmds_per_tick=0, crash_prob=0.5,
                      crash_epoch=32)
-    eps, elections, elapsed, timed_ticks, _, _ = _timed_chunks(
+    eps, elections, elapsed, timed_ticks, st_ref, m_ref = _timed_chunks(
         cfg, n_groups, ticks, lambda st, m: int(m.elections))
-    log(f"  election rounds {n_groups} groups x {timed_ticks} ticks: "
+    log(f"  [xla] election rounds {n_groups} groups x {timed_ticks} ticks: "
         f"{elections} elections in {elapsed:.2f}s -> {eps:,.0f} elections/s")
-    return eps, elections
+    def same(sr, mr, sp, mp):
+        return (int(mr.elections) == int(mp.elections)
+                and np.array_equal(np.asarray(mr.leaderless),
+                                   np.asarray(mp.leaderless)))
+
+    engine = "xla-scan"
+    p_rate, p_count, _, status = _pallas_segment(
+        cfg, n_groups, timed_ticks, "kelections", same,
+        st_ref, m_ref, "elections")
+    if status == "ok" and p_rate > eps:
+        eps, elections = p_rate, p_count
+        engine = "pallas-fused-chunk"
+    elif status == "mismatch":
+        engine = "xla-scan (pallas mismatch!)"
+    return eps, elections, engine
 
 
 def bench_reads(n_groups: int, ticks: int):
@@ -240,7 +255,6 @@ def bench_reads(n_groups: int, ticks: int):
         f"ticks (read_every={cfg.read_every}): {reads} reads in "
         f"{elapsed:.2f}s -> {rps:,.0f} reads/s")
     engine = "xla-scan"
-    from raft_tpu.sim import pkernel
 
     def same(sr, mr, sp, mp):
         return (np.array_equal(np.asarray(mr.committed),
@@ -249,7 +263,7 @@ def bench_reads(n_groups: int, ticks: int):
                                    np.asarray(sp.nodes.reads_done)))
 
     p_rate, p_count, _, status = _pallas_segment(
-        cfg, n_groups, timed_ticks, pkernel.kreads, same,
+        cfg, n_groups, timed_ticks, "kreads", same,
         st_ref, m_ref, "reads")
     if status == "ok" and p_rate > rps:
         rps, reads = p_rate, p_count
@@ -295,7 +309,7 @@ def main():
     p50, p99, n_elections, censored, max_lat, p99_note = bench_elections(
         e_groups, e_ticks)
     log("election rounds (config-2 shape):")
-    eps, n_c2_elections = bench_election_rounds(r_groups, r_ticks)
+    eps, n_c2_elections, c2_engine = bench_election_rounds(r_groups, r_ticks)
     log("linearizable reads (config-5 shape + ReadIndex schedule):")
     reads_ps, n_reads, reads_engine = bench_reads(rd_groups, rd_ticks)
 
@@ -318,6 +332,7 @@ def main():
         "elections_observed": n_elections,
         "elections_per_sec": round(eps, 1),
         "config2_elections_observed": n_c2_elections,
+        "config2_engine": c2_engine,
         "config2_note": "schedule-bound rate; see bench_election_rounds",
         "linearizable_reads_per_sec": round(reads_ps, 1),
         "reads_observed": n_reads,

@@ -12,11 +12,12 @@ one write per chunk, turning the simulation compute-bound.
 
 Semantics are the SAME tick as `sim/step.py` — each helper here is a
 line-for-line port of its namesake — restricted to the statically-
-specialized feature subset of the headline benchmark: the reconfig /
-prevote / transfer / scheduled-read schedules all OFF (`supported()`),
-which is exactly the program step.py's static fast paths compile for
-that config. Crash / partition / drop faults ARE supported (they are in
-the headline config). Callers use the XLA path for anything else;
+specialized subset `supported()` names: the reconfig / prevote /
+transfer schedules OFF (exactly the program step.py's static fast
+paths compile for the bench configs), with crash / partition / drop
+faults AND the scheduled-read (ReadIndex) pipeline statically gated
+in, like step.py's `read_every` blocks. Callers use the XLA path for
+anything else;
 `tests/test_pkernel.py` holds the two paths bit-identical on full State
 pytrees and metrics across fault mixes.
 

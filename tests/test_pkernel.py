@@ -49,19 +49,15 @@ def _diff(cfg, n_ticks, chunks=None):
     return stp
 
 
-@pytest.mark.slow
-def test_headline_config_bit_exact():
-    """The bench headline shape (fault-free, k=5, L=32) in miniature,
-    including the pad path (12 groups -> one 1024-group block). Slow
-    tier: the L=32 interpret-mode compile is minutes on CPU; the fast
-    tier covers the same program at L=8 below, and bench.py's in-run
-    full-shape differential covers L=32 on the real TPU."""
-    _diff(RaftConfig(n_groups=12, seed=42), 32)
-
-
 def test_headline_config_small_window():
     """The headline program shape at a small ring (k=5, L=8), incl. the
-    pad path (12 groups -> one 1024-group block)."""
+    pad path (12 groups -> one 1024-group block). The true L=32 program
+    is NOT exercised here: its interpret-mode CPU compile exceeds an
+    hour (the L-squared apply unroll plus L-wide tree selects), which
+    no test tier can carry — instead bench.py runs a strictly stronger
+    gate every round: the full-shape (100K-group, L=32) committed-
+    vector differential against the XLA path on the real TPU, which
+    must pass before any kernel number is reported."""
     _diff(RaftConfig(n_groups=12, seed=42, log_cap=8, compact_every=4), 32)
 
 
@@ -103,6 +99,25 @@ def test_unsupported_config_raises():
         with pytest.raises(ValueError):
             pkernel.prun(bad, state.init(bad, n_groups=4), 4,
                          interpret=True)
+
+
+def test_engine_hop_via_checkpoint(tmp_path):
+    """Interop: run the first half in the kernel, checkpoint the
+    finished State, reload, finish on the XLA path — bit-equal to an
+    unbroken XLA run. The kernel is a drop-in engine for the same
+    universe, checkpoints included."""
+    from raft_tpu.sim import checkpoint
+    cfg = RaftConfig(n_groups=8, k=3, seed=17, drop_prob=0.04,
+                     log_cap=8, compact_every=4)
+    st0 = state.init(cfg)
+    stp, mp = pkernel.prun(cfg, st0, 24, interpret=True)
+    path = tmp_path / "ckpt.npz"
+    checkpoint.save(path, stp, 24, mp, cfg=cfg)
+    st1, t1, m1 = checkpoint.load(path, cfg=cfg)
+    resumed, mr = run(cfg, st1, 24, t1, m1)
+    unbroken, mu = run(cfg, st0, 48)
+    assert trees_equal(unbroken, resumed)
+    assert np.array_equal(np.asarray(mu.committed), np.asarray(mr.committed))
 
 
 def test_kstate_round_trip():
