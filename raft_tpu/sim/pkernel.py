@@ -11,13 +11,14 @@ once. HBM traffic drops from O(ticks) full-state passes to one read +
 one write per chunk, turning the simulation compute-bound.
 
 Semantics are the SAME tick as `sim/step.py` — each helper here is a
-line-for-line port of its namesake — restricted to the statically-
-specialized subset `supported()` names: the reconfig / prevote /
-transfer schedules OFF (exactly the program step.py's static fast
-paths compile for the bench configs), with crash / partition / drop
-faults AND the scheduled-read (ReadIndex) pipeline statically gated
-in, like step.py's `read_every` blocks. Callers use the XLA path for
-anything else;
+line-for-line port of its namesake, with every feature statically
+gated exactly as step.py gates it: crash / partition / drop faults,
+the scheduled-read (ReadIndex) pipeline, single-server membership
+change (derived config, voters-aware quorums, removed-leader
+demotion), PreVote, and leadership transfer. The kernel is
+feature-complete with the batched path; only the per-tick
+election-latency histogram lives solely on the XLA path (sim.run),
+which remains the reference engine.
 `tests/test_pkernel.py` holds the two paths bit-identical on full State
 pytrees and metrics across fault mixes.
 
@@ -66,8 +67,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_tpu.config import RaftConfig
-from raft_tpu.core.node import CANDIDATE, FOLLOWER, LEADER, NO_VOTE
+from raft_tpu.config import CONFIG_FLAG, RaftConfig
+from raft_tpu.core.node import (CANDIDATE, FOLLOWER, LEADER,
+                                NO_VOTE, PRECANDIDATE)
 from raft_tpu.sim.run import Metrics
 from raft_tpu.sim.state import BOOL, I32, Mailbox, PerNode, State
 from raft_tpu.utils import jrng
@@ -78,11 +80,12 @@ GB = SUB * LANE   # groups per block (1024): ~5 MB of VMEM state/block
 
 
 def supported(cfg: RaftConfig) -> bool:
-    """The statically-specialized subset this kernel implements: the
-    fault classes and the scheduled-read pipeline; reconfig / prevote /
-    transfer stay on the XLA path."""
-    return (cfg.reconfig_u32 == 0 and not cfg.prevote
-            and cfg.transfer_u32 == 0)
+    """Every batched-path feature is in-kernel: fault classes,
+    scheduled reads, membership change, PreVote, leadership transfer —
+    each statically gated exactly like step.py, pinned bit-identical by
+    tests/test_pkernel.py. (Kept as a function: the bench and callers
+    gate on it, and any future out-of-subset feature lands here.)"""
+    return True
 
 
 # ----------------------------------------------------------- small helpers
@@ -182,6 +185,80 @@ def _commit_candidate(cfg, match_index, last_index, i):
     return rows[cfg.majority - 2]
 
 
+# ------------------------------------------------------- membership config
+# Ports of step.py's derived-config helpers. Traced bit positions go
+# through K-term one-hot sums (static shift amounts only).
+
+
+def _popcount(x):
+    """Set bits of an i32 mask (SWAR; k <= 30 bits)."""
+    x = x - ((x >> 1) & 0x55555555)
+    x = (x & 0x33333333) + ((x >> 2) & 0x33333333)
+    x = (x + (x >> 4)) & 0x0F0F0F0F
+    return (x * 0x01010101) >> 24
+
+
+def _voter_majority(voters):
+    return _popcount(voters) // 2 + 1
+
+
+def _bit_at(voters, i, k: int):
+    """(voters >> i) & 1 for a TRACED i, as a static one-hot sum."""
+    out = voters & 0
+    for j in range(k):
+        out = out + jnp.where(i == j, (voters >> j) & 1, 0)
+    return out
+
+
+def _onehot_mask(target, k: int):
+    """1 << target for a TRACED target, as a static one-hot sum."""
+    out = None
+    for j in range(k):
+        term = jnp.where(target == j, jnp.int32(1 << j), 0)
+        out = term if out is None else out + term
+    return out
+
+
+def _config_scan(cfg, ns: PerNode, through):
+    """step._config_scan: latest CONFIG_FLAG entry <= `through` in the
+    live window, else the snapshot's config."""
+    absidx = _abs_index(cfg, ns)
+    is_cfg = (((ns.log_payload & CONFIG_FLAG) != 0)
+              & (absidx <= jnp.minimum(ns.last_index, through)))
+    best = jnp.max(jnp.where(is_cfg, absidx, 0), axis=0)   # 0 == none
+    found = best > 0
+    mask_at = jnp.sum(
+        jnp.where(is_cfg & (absidx == best), ns.log_payload, 0),
+        axis=0) & cfg.full_mask
+    return (jnp.where(found, mask_at, ns.snap_voters),
+            jnp.where(found, best, ns.snap_index))
+
+
+def _current_config(cfg, ns: PerNode):
+    if cfg.reconfig_u32 == 0:          # static fast path (step.py)
+        return jnp.int32(cfg.full_mask), ns.snap_index
+    return _config_scan(cfg, ns, jnp.int32(0x7FFFFFFF))
+
+
+def _committed_voters(cfg, ns: PerNode, commit):
+    if cfg.reconfig_u32 == 0:
+        return jnp.int32(cfg.full_mask)
+    return _config_scan(cfg, ns, commit)[0]
+
+
+def _vote_quorum(cfg, ns: PerNode, votes):
+    """step._vote_quorum: granted votes from CURRENT-config voters reach
+    that config's majority."""
+    if cfg.reconfig_u32 == 0:
+        return _vote_count(votes) >= cfg.majority
+    voters, _ = _current_config(cfg, ns)
+    granted = None
+    for j in range(cfg.k):
+        term = (votes[j] & (((voters >> j) & 1) == 1)).astype(I32)
+        granted = term if granted is None else granted + term
+    return granted >= _voter_majority(voters)
+
+
 # -------------------------------------------------------------- transitions
 # Ports of step.py's masked transition helpers (same names, same order
 # of field writes). `g` is the [8, 128] group-id tile; `i` the node's
@@ -278,7 +355,7 @@ def _on_rv_resp(cfg, ns, out, g, i, src: int, ib, gl):
             & (m_term == ns.term) & m_granted)
     votes = _krow_or(ns.votes, src, cont)
     ns = ns._replace(votes=votes)
-    won = cont & (_vote_count(votes) >= cfg.majority)
+    won = cont & _vote_quorum(cfg, ns, votes)
     return _become_leader(cfg, ns, i, won), out
 
 
@@ -461,7 +538,7 @@ def _start_election_masked(cfg, ns, out, g, i, cond):
         votes=(ns.votes & ~cond) | (cond & (_col(cfg.k) == i)),
     )
     ns = _reset_timer(cfg, ns, g, i, cond)
-    won = cond & (_vote_count(ns.votes) >= cfg.majority)
+    won = cond & _vote_quorum(cfg, ns, ns.votes)   # instant single-voter win
     ns = _become_leader(cfg, ns, i, won)
     llt = _last_log_term(cfg, ns)
     for p in range(cfg.k):
@@ -475,8 +552,68 @@ def _start_election_masked(cfg, ns, out, g, i, cond):
     return ns, out
 
 
+def _on_pv_req(cfg, ns, out, g, i, src: int, ib, gl):
+    """step._on_pv_req: non-binding pre-vote grant — proposed term
+    ahead, log up-to-date, not the leader, lease expired. No term
+    adoption, no voted_for, no timer reset."""
+    if not cfg.prevote:
+        return ns, out
+    present = ib.pv_req_present[src]
+    m_term = ib.pv_req_term[src]
+    m_lli = ib.pv_req_lli[src]
+    m_llt = ib.pv_req_llt[src]
+    llt = _last_log_term(cfg, ns)
+    log_ok = (m_llt > llt) | ((m_llt == llt) & (m_lli >= ns.last_index))
+    grant = (present & (m_term > ns.term) & log_ok & (ns.role != LEADER)
+             & (ns.leader_elapsed >= cfg.election_min))
+    out = out._replace(
+        pv_resp_present=_put(out.pv_resp_present, src, present, True),
+        pv_resp_term=_put(out.pv_resp_term, src, present, ns.term),
+        pv_resp_req_term=_put(out.pv_resp_req_term, src, present, m_term),
+        pv_resp_granted=_put(out.pv_resp_granted, src, present, grant),
+    )
+    return ns, out
+
+
+def _on_pv_resp(cfg, ns, out, g, i, src: int, ib, gl):
+    """step._on_pv_resp: tally pre-votes; a quorum starts the REAL
+    election (term bump + RequestVote broadcast) right here in phase D."""
+    if not cfg.prevote:
+        return ns, out
+    present = ib.pv_resp_present[src]
+    m_term = ib.pv_resp_term[src]
+    m_req = ib.pv_resp_req_term[src]
+    m_granted = ib.pv_resp_granted[src]
+    higher = present & (m_term > ns.term)
+    ns = _step_down(cfg, ns, m_term, higher)
+    cont = (present & ~higher & (ns.role == PRECANDIDATE)
+            & (m_req == ns.term + 1) & m_granted)
+    votes = _krow_or(ns.votes, src, cont)
+    ns = ns._replace(votes=votes)
+    won_pre = cont & _vote_quorum(cfg, ns, votes)
+    return _start_election_masked(cfg, ns, out, g, i, won_pre)
+
+
+def _on_tn_req(cfg, ns, out, g, i, src: int, ib, gl):
+    """step._on_tn_req: TimeoutNow — campaign immediately, bypassing
+    PreVote. FOLLOWER/PRECANDIDATE only (a CANDIDATE already campaigned
+    and a second start would double-write the RV slot)."""
+    if not cfg.transfer_u32:
+        return ns, out
+    present = ib.tn_present[src]
+    m_term = ib.tn_term[src]
+    ns = _step_down(cfg, ns, m_term, present & (m_term > ns.term))
+    cond = (present & (m_term == ns.term)
+            & (ns.role != LEADER) & (ns.role != CANDIDATE))
+    if cfg.reconfig_u32:
+        voters, _ = _current_config(cfg, ns)
+        cond = cond & (_bit_at(voters, i, cfg.k) == 1)
+    return _start_election_masked(cfg, ns, out, g, i, cond)
+
+
 _HANDLERS = (_on_rv_req, _on_rv_resp, _on_ae_req, _on_ae_resp,
-             _on_is_req, _on_is_resp)
+             _on_is_req, _on_is_resp, _on_pv_req, _on_pv_resp, _on_tn_req)
+#             canonical rpc type order (PV/TN last — step.py/rpc.py)
 
 
 # ------------------------------------------------------------- phases T/C/A
@@ -518,11 +655,62 @@ def _phase_t(cfg, ns, out, g, i, t):
             ae_req_commit=_put(out.ae_req_commit, p, use_ae, ns.commit),
         )
 
+    if cfg.transfer_u32:
+        # step._phase_t scheduled transfer: first tick of a firing
+        # epoch, hash-chosen target, gated on current-config voter +
+        # fully-caught-up peer (self match slot is always 0, so the max
+        # ranges over peers only).
+        epoch = t // cfg.transfer_epoch
+        attempts = (is_leader & ((t % cfg.transfer_epoch) == 0)
+                    & jrng.transfer_fires(cfg.seed, g, epoch,
+                                          cfg.transfer_u32))
+        target = jrng.transfer_target(cfg.seed, g, epoch, cfg.k)
+        mt = _lget(ns.match_index, target)
+        caught_up = ((mt >= ns.commit)
+                     & (mt == jnp.max(ns.match_index, axis=0)))
+        okT = attempts & caught_up & (target != i)
+        if cfg.reconfig_u32:
+            votersT, _ = _current_config(cfg, ns)
+            okT = okT & (_bit_at(votersT, target, cfg.k) == 1)
+        for p in range(cfg.k):
+            send = okT & (target == p)
+            out = out._replace(
+                tn_present=_put(out.tn_present, p, send, True),
+                tn_term=_put(out.tn_term, p, send, ns.term),
+            )
+
     ee = ns.election_elapsed + 1
     timeout = ~is_leader & (ee >= ns.deadline)
+    if cfg.reconfig_u32:
+        # Non-voters never campaign (step.py:624-626).
+        voters0, _ = _current_config(cfg, ns)
+        timeout = timeout & (_bit_at(voters0, i, cfg.k) == 1)
     ns = ns._replace(
         election_elapsed=jnp.where(is_leader, ns.election_elapsed, ee),
         leader_elapsed=jnp.where(is_leader, 0, ns.leader_elapsed + 1))
+    if cfg.prevote:
+        # step._phase_t pre-ballot: pre-candidacy, no term bump; the
+        # single-voter config skips straight to the real election
+        # (matching the CPU's nested _start_election call, including
+        # its second deadline draw).
+        ns = ns._replace(
+            role=jnp.where(timeout, PRECANDIDATE, ns.role),
+            leader_id=jnp.where(timeout, NO_VOTE, ns.leader_id),
+            votes=(ns.votes & ~timeout) | (timeout & (_col(cfg.k) == i)),
+        )
+        ns = _reset_timer(cfg, ns, g, i, timeout)
+        skip = timeout & _vote_quorum(cfg, ns, ns.votes)
+        ns, out = _start_election_masked(cfg, ns, out, g, i, skip)
+        llt = _last_log_term(cfg, ns)
+        for p in range(cfg.k):
+            send = timeout & ~skip & (i != p)
+            out = out._replace(
+                pv_req_present=_put(out.pv_req_present, p, send, True),
+                pv_req_term=_put(out.pv_req_term, p, send, ns.term + 1),
+                pv_req_lli=_put(out.pv_req_lli, p, send, ns.last_index),
+                pv_req_llt=_put(out.pv_req_llt, p, send, llt),
+            )
+        return ns, out
     return _start_election_masked(cfg, ns, out, g, i, timeout)
 
 
@@ -539,6 +727,30 @@ def _phase_c(cfg, ns, g, t):
         ns = ns._replace(
             sched_read_index=jnp.where(reg, ns.commit, ns.sched_read_index),
             sched_read_reg=jnp.where(reg, t, ns.sched_read_reg),
+        )
+
+    if cfg.reconfig_u32:
+        # step._phase_c scheduled reconfig: first tick of a firing epoch,
+        # single-server toggle of a hash-chosen node, gated on the
+        # previous config being committed + min-voters + current-term.
+        epoch = t // cfg.reconfig_epoch
+        fires = ((t % cfg.reconfig_epoch) == 0) & jrng.reconfig_fires(
+            cfg.seed, g, epoch, cfg.reconfig_u32)
+        target = jrng.reconfig_target(cfg.seed, g, epoch, cfg.k)
+        voters, cfg_index = _current_config(cfg, ns)
+        new_mask = voters ^ _onehot_mask(target, cfg.k)
+        gate = ((_popcount(new_mask) >= cfg.effective_min_voters)
+                & (cfg_index <= ns.commit)
+                & (_term_at(cfg, ns, ns.commit) == ns.term))
+        idx = ns.last_index + 1
+        room = (idx - ns.snap_index) <= cfg.log_cap
+        do = lead & fires & gate & room
+        sl = _slot(cfg, idx)
+        ns = ns._replace(
+            log_term=_lset(ns.log_term, sl, do, ns.term),
+            log_payload=_lset(ns.log_payload, sl, do,
+                              jnp.int32(CONFIG_FLAG) | new_mask),
+            last_index=jnp.where(do, idx, ns.last_index),
         )
 
     last_index = ns.last_index
@@ -558,11 +770,49 @@ def _phase_c(cfg, ns, g, t):
                        log_payload=log_payload)
 
 
+def _commit_candidate_voters(cfg, match_index, last_index, i, voters):
+    """ops.quorum.commit_candidate_voters as a compare-exchange network
+    with a dynamic (one-hot-selected) pick: the voter_majority-th
+    largest replication index among voters; -1 when no voters exist
+    (the caller's n > commit guard rejects it)."""
+    rows = []
+    for j in range(cfg.k):
+        v = jnp.where(jnp.int32(j) == i, last_index, match_index[j])
+        rows.append(jnp.where(((voters >> j) & 1) == 1, v, jnp.int32(-1)))
+    for a in range(cfg.k):          # selection-sort network, descending
+        for b in range(a + 1, cfg.k):
+            hi = jnp.maximum(rows[a], rows[b])
+            lo = jnp.minimum(rows[a], rows[b])
+            rows[a], rows[b] = hi, lo
+    pick = _voter_majority(voters) - 1
+    out = rows[0] & 0
+    for j in range(cfg.k):
+        out = out + jnp.where(pick == j, rows[j], 0)
+    return out
+
+
 def _phase_a(cfg, ns, i):
-    n = _commit_candidate(cfg, ns.match_index, ns.last_index, i)
+    if cfg.reconfig_u32 == 0:
+        n = _commit_candidate(cfg, ns.match_index, ns.last_index, i)
+    else:
+        voters, cfg_index = _current_config(cfg, ns)
+        n = _commit_candidate_voters(cfg, ns.match_index, ns.last_index,
+                                     i, voters)
     advance = ((ns.role == LEADER) & (n > ns.commit)
                & (_term_at(cfg, ns, n) == ns.term))
     commit = jnp.where(advance, n, ns.commit)
+
+    if cfg.reconfig_u32:
+        # A removed leader steps down once its removal is committed
+        # (step.py:738-748): latest config entry committed, self not in.
+        self_voter = _bit_at(voters, i, cfg.k) == 1
+        demote = (ns.role == LEADER) & (cfg_index <= commit) & ~self_voter
+        ns = ns._replace(
+            role=jnp.where(demote, FOLLOWER, ns.role),
+            leader_id=jnp.where(demote, NO_VOTE, ns.leader_id),
+            votes=ns.votes & ~demote,
+        )
+        ns = _drop_reads(cfg, ns, demote)
 
     applied, digest = ns.applied, ns.digest
     for _ in range(cfg.log_cap):
@@ -577,19 +827,32 @@ def _phase_a(cfg, ns, i):
     ns = ns._replace(
         commit=commit, applied=applied, digest=digest,
         snap_term=jnp.where(compact, _term_at(cfg, ns, commit), ns.snap_term),
+        snap_voters=jnp.where(compact, _committed_voters(cfg, ns, commit),
+                              ns.snap_voters),
         snap_index=jnp.where(compact, commit, ns.snap_index),
         snap_digest=jnp.where(compact, digest, ns.snap_digest),
     )
     if cfg.read_every:
-        # Scheduled-read completion (step.py phase A end; reconfig is
-        # statically off in this kernel, so the quorum is the full-set
-        # majority and every lane is a voter).
+        # Scheduled-read completion (step.py phase A end): voters-aware
+        # ReadIndex quorum over the ack evidence.
         sched = ns.sched_read_index >= 0
         recent = ns.ack_time >= ns.sched_read_reg + 2
         not_self = _col(cfg.k) != i
-        acks = jnp.sum((recent & not_self).astype(I32), axis=0)
-        done = (sched & (acks + 1 >= cfg.majority)
-                & (ns.applied >= ns.sched_read_index))
+        if cfg.reconfig_u32 == 0:
+            acks = jnp.sum((recent & not_self).astype(I32), axis=0)
+            done = (sched & (acks + 1 >= cfg.majority)
+                    & (ns.applied >= ns.sched_read_index))
+        else:
+            voters2, _ = _current_config(cfg, ns)
+            acks = None
+            for j in range(cfg.k):
+                vlane = ((voters2 >> j) & 1) == 1
+                term = (recent[j] & vlane & (jnp.int32(j) != i)).astype(I32)
+                acks = term if acks is None else acks + term
+            self_voter2 = _bit_at(voters2, i, cfg.k)
+            done = (sched
+                    & (acks + self_voter2 >= _voter_majority(voters2))
+                    & (ns.applied >= ns.sched_read_index))
         ns = ns._replace(
             reads_done=ns.reads_done + done.astype(I32),
             sched_read_index=jnp.where(done, -1, ns.sched_read_index),
@@ -604,6 +867,13 @@ def _node_tick(cfg, t, ns: PerNode, inbox, g, i, glog_t, glog_p):
     fK = jnp.broadcast_to(g, (cfg.k,) + g.shape) < 0
     zK = jnp.zeros((cfg.k, 1, 1), I32) + (g & 0)
     zKu = zK.astype(jnp.uint32)
+    pv = {}
+    if cfg.prevote:
+        pv = dict(pv_req_present=fK, pv_req_term=zK, pv_req_lli=zK,
+                  pv_req_llt=zK, pv_resp_present=fK, pv_resp_term=zK,
+                  pv_resp_req_term=zK, pv_resp_granted=fK)
+    if cfg.transfer_u32:
+        pv.update(tn_present=fK, tn_term=zK)
     out = Mailbox(
         rv_req_present=fK, rv_resp_present=fK, rv_resp_granted=fK,
         ae_req_present=fK, ae_resp_present=fK, ae_resp_success=fK,
@@ -613,7 +883,7 @@ def _node_tick(cfg, t, ns: PerNode, inbox, g, i, glog_t, glog_p):
         ae_req_n=zK, ae_req_commit=zK, ae_resp_term=zK, ae_resp_match=zK,
         is_req_term=zK, is_req_snap_index=zK, is_req_snap_term=zK,
         is_req_snap_digest=zKu, is_req_snap_voters=zK,
-        is_resp_term=zK, is_resp_match=zK)
+        is_resp_term=zK, is_resp_match=zK, **pv)
     gl = (glog_t, glog_p, t)
     for handler in _HANDLERS:
         for src in range(cfg.k):
@@ -672,6 +942,12 @@ def _filter_mailbox(cfg, mb: Mailbox, t, alive_now, g) -> Mailbox:
     if cfg.drop_u32:
         keep = keep & ~jrng.link_dropped(cfg.seed, gg, t, src, dst,
                                          cfg.drop_u32)
+    pv = {}
+    if cfg.prevote:
+        pv = dict(pv_req_present=mb.pv_req_present & keep,
+                  pv_resp_present=mb.pv_resp_present & keep)
+    if cfg.transfer_u32:
+        pv["tn_present"] = mb.tn_present & keep
     return mb._replace(
         rv_req_present=mb.rv_req_present & keep,
         rv_resp_present=mb.rv_resp_present & keep,
@@ -679,6 +955,7 @@ def _filter_mailbox(cfg, mb: Mailbox, t, alive_now, g) -> Mailbox:
         ae_resp_present=mb.ae_resp_present & keep,
         is_req_present=mb.is_req_present & keep,
         is_resp_present=mb.is_resp_present & keep,
+        **pv,
     )
 
 
@@ -713,6 +990,12 @@ def _tick(cfg, nodes, mailbox, alive_prev, g, t):
     def erase(p):   # presence slots are i32 here (see _node_tick tail)
         return jnp.where(src_alive, p, 0)
 
+    pv = {}
+    if cfg.prevote:
+        pv = dict(pv_req_present=erase(outbox.pv_req_present),
+                  pv_resp_present=erase(outbox.pv_resp_present))
+    if cfg.transfer_u32:
+        pv["tn_present"] = erase(outbox.tn_present)
     outbox = outbox._replace(
         rv_req_present=erase(outbox.rv_req_present),
         rv_resp_present=erase(outbox.rv_resp_present),
@@ -720,6 +1003,7 @@ def _tick(cfg, nodes, mailbox, alive_prev, g, t):
         ae_resp_present=erase(outbox.ae_resp_present),
         is_req_present=erase(outbox.is_req_present),
         is_resp_present=erase(outbox.is_resp_present),
+        **pv,
     )
     return new_nodes, outbox, alive_now
 
@@ -728,11 +1012,14 @@ def _tick(cfg, nodes, mailbox, alive_prev, g, t):
 
 _MB_BOOL = ("rv_req_present", "rv_resp_present", "rv_resp_granted",
             "ae_req_present", "ae_resp_present", "ae_resp_success",
-            "is_req_present", "is_resp_present")
+            "is_req_present", "is_resp_present",
+            "pv_req_present", "pv_resp_present", "pv_resp_granted",
+            "tn_present")
 
-_OPTIONAL_MB = ("pv_req_present", "pv_req_term", "pv_req_lli", "pv_req_llt",
-                "pv_resp_present", "pv_resp_term", "pv_resp_req_term",
-                "pv_resp_granted", "tn_present", "tn_term")
+_PV_MB = ("pv_req_present", "pv_req_term", "pv_req_lli", "pv_req_llt",
+          "pv_resp_present", "pv_resp_term", "pv_resp_req_term",
+          "pv_resp_granted")
+_TN_MB = ("tn_present", "tn_term")
 
 
 class KMetrics(NamedTuple):
@@ -770,10 +1057,17 @@ def _node_leaves(cfg):
 
 
 def _mb_fields(cfg):
-    """Static names of the mailbox leaves in the supported subset. NO
-    array construction: this runs inside the kernel trace, where even a
-    dead jnp.zeros(bool) lowers to an i1 vector constant LLO rejects."""
-    return [f for f in Mailbox._fields if f not in _OPTIONAL_MB]
+    """Static names of the mailbox leaves present under `cfg` (PreVote /
+    TimeoutNow slots exist only when their schedules are on, mirroring
+    state.empty_mailbox). NO array construction: this runs inside the
+    kernel trace, where even a dead jnp.zeros(bool) lowers to an i1
+    vector constant LLO rejects."""
+    skip = set()
+    if not cfg.prevote:
+        skip.update(_PV_MB)
+    if not cfg.transfer_u32:
+        skip.update(_TN_MB)
+    return [f for f in Mailbox._fields if f not in skip]
 
 
 def _fold_g(a):
