@@ -34,7 +34,28 @@ SESSION_REGISTER = SESSION_FLAG | (SESSION_SID_MASK << SESSION_SID_SHIFT)
 
 
 def session_payload(sid: int, seq: int, val: int) -> int:
-    assert 0 <= sid < SESSION_SID_MASK and 0 <= seq <= SESSION_SEQ_MASK
+    """Encode an exactly-once session command.
+
+    Raises ValueError (not assert — asserts vanish under `python -O`,
+    and an out-of-range sid/seq would silently alias ANOTHER session's
+    slot, corrupting the exactly-once filter) on sid outside
+    [0, SESSION_SID_MASK) or seq outside [0, SESSION_SEQ_MASK].
+
+    Lifetime limit: seq is a 10-bit field, so a session can issue at
+    most SESSION_SEQ_MASK + 1 = 1024 commands (seq 0..1023) before the
+    client must register a fresh session — the filter keeps only the
+    highest applied seq per sid, so a wrapped seq would be dropped as a
+    duplicate, never double-applied.
+    """
+    if not 0 <= sid < SESSION_SID_MASK:
+        raise ValueError(
+            f"session sid {sid} outside [0, {SESSION_SID_MASK}) "
+            f"(sid {SESSION_SID_MASK:#x} is the reserved REGISTER marker)")
+    if not 0 <= seq <= SESSION_SEQ_MASK:
+        raise ValueError(
+            f"session seq {seq} outside [0, {SESSION_SEQ_MASK}] — a "
+            f"session's lifetime is {SESSION_SEQ_MASK + 1} commands; "
+            f"open a new session instead of wrapping")
     return (SESSION_FLAG | (sid << SESSION_SID_SHIFT)
             | (seq << SESSION_SEQ_SHIFT) | (val & SESSION_VAL_MASK))
 

@@ -167,9 +167,15 @@ class Cluster:
     def open_session(self, max_ticks: int = 200):
         """Register a client session (dissertation §6.3): propose the
         REGISTER entry, tick until it commits, and return the
-        index-derived session id (or None if it never committed — the
-        takeover re-proposal never displaces entries, so the only
-        failure is a lost ticket; callers retry). cfg.sessions only."""
+        index-derived session id (or None if nothing commits within the
+        budget). cfg.sessions only.
+
+        A ticket is LOST when its leader is deposed before replication
+        and a later leader commits a different payload at that index —
+        detected below via the commit-identity map, which resets the
+        ticket so the loop re-proposes immediately instead of burning
+        the remaining tick budget waiting on an index that can never
+        hold a REGISTER again."""
         ticket = None
         for _ in range(max_ticks):
             if ticket is None:
@@ -183,7 +189,10 @@ class Cluster:
                 if self._session_owner.get(sid) == ticket[0]:
                     return sid
                 ticket = None            # collision no-op: re-register
-            self.tick()
+            elif (ticket is not None
+                  and self._committed.get(ticket[0]) is not None):
+                ticket = None            # lost ticket: index taken by
+            self.tick()                  # another payload — re-propose
         return None
 
     def propose_seq(self, sid: int, seq: int, val: int):

@@ -30,11 +30,31 @@ AXIS = "g"
 
 
 def _pvary(x, axis):
-    """Mark `x` as varying over `axis` (API name moved across jax versions:
-    prefer the current `pcast`; `pvary` is the deprecated spelling)."""
+    """Mark `x` as varying over `axis` (API name moved across jax
+    versions: prefer the current `pcast`; `pvary` is the deprecated
+    spelling). On jax builds with NEITHER (0.4.x), `_shard_map` below
+    disables the replication checker entirely (check_rep=False — the
+    varying/replicated distinction does not exist yet), so marking is
+    unnecessary and this is the identity."""
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, (axis,), to="varying")
-    return jax.lax.pvary(x, (axis,))
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, (axis,))
+    return x
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """`jax.shard_map` for every jax this repo meets: top-level on
+    current jax, `jax.experimental.shard_map` on 0.4.x — where
+    check_rep must be False (its replication checker predates
+    pcast/pvary and rejects the metrics carry `run_sharded` marks
+    varying by hand; pallas_call under shard_map also requires it)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
 
 
 def make_mesh(n_devices: int | None = None, devices=None,
@@ -102,6 +122,6 @@ def run_sharded(cfg: RaftConfig, st: State, n_ticks: int, mesh: Mesh,
             max_latency=jax.lax.pmax(m.max_latency, AXIS),
         )
 
-    f = jax.shard_map(local, mesh=mesh, in_specs=(P(AXIS),),
-                      out_specs=(P(AXIS), P()))
+    f = _shard_map(local, mesh=mesh, in_specs=(P(AXIS),),
+                   out_specs=(P(AXIS), P()))
     return jax.jit(f)(st)

@@ -16,11 +16,31 @@ gated exactly as step.py gates it: crash / partition / drop faults,
 the scheduled-read (ReadIndex) pipeline, single-server membership
 change (derived config, voters-aware quorums, removed-leader
 demotion), PreVote, and leadership transfer. The kernel is
-feature-complete with the batched path; only the per-tick
-election-latency histogram lives solely on the XLA path (sim.run),
-which remains the reference engine.
+feature-complete with the batched path INCLUDING metrics: the
+election-latency histogram is tracked in-kernel as per-group
+[H, 8, 128] accumulators (one-hot row add per tick, no scatter) and
+reduced over groups at `kfinish`, so fault benches can ride the kernel
+and report p50/p99 bit-identical to the XLA path (sim.run), which
+remains the reference engine.
 `tests/test_pkernel.py` holds the two paths bit-identical on full State
-pytrees and metrics across fault mixes.
+pytrees and metrics — histogram included — across fault mixes.
+
+`_on_ae_req` is the fused form of step.py's handler (DESIGN.md §7b):
+the four per-sender log-matching read passes (2E own-ring reads + 2E
+sender-ring pulls per message) collapse into ONE packed elementwise
+compare of the receiver's ring against the sender's, exploiting the
+slot identity — an absolute index occupies ring slot (i-1) % L on
+EVERY node, so the sender-side read slot and the receiver-side write
+slot of an entry are the same slot, the write values are just the
+sender's ring rows, and per-entry equality is a bit-select from the
+packed compare. The own-ring `_abs_index` pass and its live-window
+mask hoist to once per tick (snap_index cannot change before the
+InstallSnapshot handler, which runs after all AE handlers). What does
+NOT hoist across senders: reads of the receiver's log CONTENT — a
+second same-tick AppendEntries (two leaders in adjacent terms) must
+observe the first one's writes, exactly like step.py's and the CPU
+oracle's sequential delivery, so the packed compare is per-sender
+against the CURRENT ring.
 
 Layout ("k-state"): the group axis folds into full vector registers: G
 groups become a trailing [GS, 128] (sublane x lane) pair with
@@ -70,22 +90,48 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_tpu.config import CONFIG_FLAG, RaftConfig
 from raft_tpu.core.node import (CANDIDATE, FOLLOWER, LEADER,
                                 NO_VOTE, PRECANDIDATE)
-from raft_tpu.sim.run import Metrics
+from raft_tpu.sim.run import HIST_SIZE, Metrics
 from raft_tpu.sim.state import BOOL, I32, Mailbox, PerNode, State
 from raft_tpu.utils import jrng
 
 LANE = 128   # lane width: trailing dim of every k-state leaf
 SUB = 8      # sublanes per block (min: block sublane dim must be 8-divisible)
 GB = SUB * LANE   # groups per block (1024): ~5 MB of VMEM state/block
+VMEM_LIMIT_BYTES = 100 * 1024 * 1024   # budget passed to the compiler
+
+
+def kernel_vmem_bytes(cfg: RaftConfig) -> int:
+    """Estimated peak VMEM bytes one grid step needs under `cfg`.
+
+    Counts the i32 words of one 1024-group block's wire leaves (node
+    state + mailbox + alive/gid + metric tiles + histogram rows), then
+    multiplies by 5: an input and an output buffer per leaf, the
+    pipeline double-buffering both, plus roughly one block's worth held
+    live in the fori_loop carry/vregs. A coarse model — it only has to
+    reject shapes that would OOM the 100 MB budget by integer factors
+    (huge L or K), not referee marginal fits."""
+    words = 0
+    for _, kind in _node_leaves(cfg):
+        words += cfg.k * {"scalar": 1, "peer": cfg.k,
+                          "ring": cfg.log_cap}[kind]
+    words += len(_mb_fields(cfg)) * cfg.k * cfg.k
+    words += 2 + 4                       # alive_prev + group_id + metrics
+    block = words * 4 * GB + HIST_SIZE * 4 * SUB * LANE
+    return 5 * block
 
 
 def supported(cfg: RaftConfig) -> bool:
     """Every batched-path feature is in-kernel: fault classes,
-    scheduled reads, membership change, PreVote, leadership transfer —
-    each statically gated exactly like step.py, pinned bit-identical by
-    tests/test_pkernel.py. (Kept as a function: the bench and callers
-    gate on it, and any future out-of-subset feature lands here.)"""
-    return True
+    scheduled reads, membership change, PreVote, leadership transfer,
+    and the election-latency histogram — each statically gated exactly
+    like step.py, pinned bit-identical by tests/test_pkernel.py.
+
+    What the predicate actually rejects: voter bitmasks live in i32
+    lanes (k <= 30 so `1 << k` and the config SWAR popcount stay exact),
+    and the per-block VMEM footprint must fit the compiler budget —
+    a [K, L] shape big enough to blow it (e.g. L in the thousands)
+    needs the XLA path, which streams through HBM instead."""
+    return cfg.k <= 30 and kernel_vmem_bytes(cfg) <= VMEM_LIMIT_BYTES
 
 
 # ----------------------------------------------------------- small helpers
@@ -360,18 +406,20 @@ def _on_rv_resp(cfg, ns, out, g, i, src: int, ib, gl):
 
 
 def _on_ae_req(cfg, ns, out, g, i, src: int, ib, gl):
-    """step._on_ae_req: receiver-pull log matching, decide-then-write."""
+    """step._on_ae_req, fused (module docstring / DESIGN.md §7b):
+    receiver-pull log matching with the four per-sender read passes
+    collapsed into one packed ring compare, decide-then-write. Ring
+    reads stay per-sender against the CURRENT log (a later same-tick
+    AE must see an earlier one's writes); only `_abs_index` and its
+    live-window mask arrive hoisted via `gl`."""
     glog_t, glog_p = gl[0], gl[1]
+    absidx, live = gl[3], gl[4]     # hoisted once per tick (_node_tick)
     present = ib.ae_req_present[src]
     m_term = ib.ae_req_term[src]
     m_prev = ib.ae_req_prev_index[src]
     m_prev_term = ib.ae_req_prev_term[src]
     m_n = ib.ae_req_n[src]
     m_commit = ib.ae_req_commit[src]
-    ent_t = [_lget(glog_t[src], _slot(cfg, m_prev + 1 + j))
-             for j in range(cfg.max_entries_per_msg)]
-    ent_p = [_lget(glog_p[src], _slot(cfg, m_prev + 1 + j))
-             for j in range(cfg.max_entries_per_msg)]
 
     ns = _step_down(cfg, ns, m_term, present & (m_term > ns.term))
     stale = present & (m_term < ns.term)
@@ -379,17 +427,22 @@ def _on_ae_req(cfg, ns, out, g, i, src: int, ib, gl):
     ns = _accept_leader(cfg, ns, g, i, src, ok)
 
     past = ok & (m_prev > ns.last_index)
-    conflict = (ok & ~past & (m_prev >= ns.snap_index)
-                & (_term_at(cfg, ns, m_prev) != m_prev_term))
     ct = _term_at(cfg, ns, m_prev)
-    absidx = _abs_index(cfg, ns)
-    bad = ((absidx > ns.snap_index) & (absidx < m_prev)
-           & (ns.log_term != ct))
+    conflict = (ok & ~past & (m_prev >= ns.snap_index)
+                & (ct != m_prev_term))
+    bad = live & (absidx < m_prev) & (ns.log_term != ct)
     ci = jnp.minimum(
         jnp.max(jnp.where(bad, absidx, ns.snap_index), axis=0) + 1, m_prev)
 
     proceed = ok & ~past & ~conflict
     j0 = jnp.maximum(0, ns.snap_index - m_prev)
+    # ONE masked pass over the paired rings replaces the 2E sender
+    # pulls + 2E own-ring reads: the same absolute index lives at the
+    # same slot on both nodes, so per-slot equality of the two rings is
+    # everything the entry walk needs — bit 0 = terms equal, bit 1 =
+    # payloads equal (packed i32: vector-bool selects do not lower).
+    cmp = ((ns.log_term == glog_t[src]).astype(I32)
+           | ((ns.log_payload == glog_p[src]).astype(I32) << 1))
     hi = m_prev + j0
     last_index = ns.last_index
     stopped = proceed & (g < 0)                 # all-false, constant-free
@@ -399,9 +452,10 @@ def _on_ae_req(cfg, ns, out, g, i, src: int, ib, gl):
         act = proceed & (j >= j0) & (j < m_n) & ~stopped
         s = _slot(cfg, idx)
         slots.append(s)
+        cj = _lget(cmp, s)
         in_log = act & (idx <= last_index)
-        same_t = in_log & (_lget(ns.log_term, s) == ent_t[j])
-        same_p = in_log & ~same_t & (_lget(ns.log_payload, s) == ent_p[j])
+        same_t = in_log & ((cj & 1) != 0)
+        same_p = in_log & ~same_t & ((cj & 2) != 0)
         diverge = in_log & ~same_t & ~same_p
         need_append = (act & ~in_log) | diverge
         room = (idx - ns.snap_index) <= cfg.log_cap
@@ -416,16 +470,14 @@ def _on_ae_req(cfg, ns, out, g, i, src: int, ib, gl):
     lanes = _col(cfg.log_cap)
     t_mask = jnp.broadcast_to(g, (cfg.log_cap,) + g.shape) < 0  # all-false
     p_mask = t_mask
-    t_val = jnp.zeros((cfg.log_cap, 1, 1), I32) + (last_index & 0)
-    p_val = t_val
     for j in range(cfg.max_entries_per_msg):
         on_j = lanes == slots[j]
         t_mask = t_mask | (on_j & write_t[j])
         p_mask = p_mask | (on_j & write_p[j])
-        t_val = jnp.where(on_j, ent_t[j], t_val)
-        p_val = jnp.where(on_j, ent_p[j], p_val)
-    log_term = jnp.where(t_mask, t_val, ns.log_term)
-    log_payload = jnp.where(p_mask, p_val, ns.log_payload)
+    # Write VALUES are the sender's ring itself (same-slot identity):
+    # no per-entry value composition to materialize.
+    log_term = jnp.where(t_mask, glog_t[src], ns.log_term)
+    log_payload = jnp.where(p_mask, glog_p[src], ns.log_payload)
 
     commit = jnp.where(
         proceed & (m_commit > ns.commit),
@@ -884,7 +936,13 @@ def _node_tick(cfg, t, ns: PerNode, inbox, g, i, glog_t, glog_p):
         is_req_term=zK, is_req_snap_index=zK, is_req_snap_term=zK,
         is_req_snap_digest=zKu, is_req_snap_voters=zK,
         is_resp_term=zK, is_resp_match=zK, **pv)
-    gl = (glog_t, glog_p, t)
+    # Hoisted own-ring geometry for the AE handlers: snap_index cannot
+    # change before _on_is_req (ordered after every _on_ae_req call in
+    # _HANDLERS) or phase A's compaction, so the [L, 8, 128] absolute-
+    # index map and its live-window mask are computed once per tick and
+    # shared across all K-1 senders instead of rebuilt per message.
+    absidx = _abs_index(cfg, ns)
+    gl = (glog_t, glog_p, t, absidx, absidx > ns.snap_index)
     for handler in _HANDLERS:
         for src in range(cfg.k):
             ns, out = handler(cfg, ns, out, g, i, src, inbox, gl)
@@ -1025,27 +1083,34 @@ _TN_MB = ("tn_present", "tn_term")
 class KMetrics(NamedTuple):
     """Per-group metric tiles carried through the kernel ([8, 128] per
     block; [GS, 128] in HBM). `elections` / `max_latency` are per-GROUP
-    here (run.Metrics keeps scalars); prun reduces them host-side. No
-    histogram: the fused chunk serves the throughput/elections benches —
-    latency-histogram runs use the XLA path (sim.run), which folds
-    metrics every tick."""
+    here (run.Metrics keeps scalars) and `hist` is a per-group
+    [H, 8, 128] streak-length histogram ([H, GS, 128] in HBM) — each
+    group's lane accumulates its own bucket counts, updated by a
+    one-hot row add (Mosaic has no scatter), and kfinish reduces over
+    groups host-side. Integer adds reassociate exactly, so the reduced
+    histogram is bit-identical to the XLA path's global scatter-add."""
     committed: jnp.ndarray
     leaderless: jnp.ndarray
     elections: jnp.ndarray
     max_latency: jnp.ndarray
+    hist: jnp.ndarray
 
 
 def _metrics_tick(m: KMetrics, nodes, alive_now) -> KMetrics:
-    """run.metrics_update against k-state values (minus the histogram)."""
+    """run.metrics_update against k-state values, histogram included."""
     committed = jnp.maximum(m.committed, jnp.max(nodes.commit, axis=0))
     has_leader = jnp.any((nodes.role == LEADER) & alive_now, axis=0)
     done = has_leader & (m.leaderless > 0)
+    hsize = m.hist.shape[0]
+    bucket = jnp.minimum(m.leaderless, hsize - 1)
+    hrow = jax.lax.broadcasted_iota(I32, (hsize, 1, 1), 0)
     return KMetrics(
         committed=committed,
         leaderless=jnp.where(has_leader, 0, m.leaderless + 1),
         elections=m.elections + done.astype(I32),
         max_latency=jnp.maximum(m.max_latency,
                                 jnp.where(done, m.leaderless, 0)),
+        hist=m.hist + ((hrow == bucket) & done).astype(I32),
     )
 
 
@@ -1136,7 +1201,8 @@ def _build_kernel(cfg, n_ticks):
     """The pallas kernel body: load block -> fori_loop of ticks -> store."""
     node_kinds = _node_leaves(cfg)
     mb_fields = _mb_fields(cfg)
-    n_in = len(node_kinds) + len(mb_fields) + 2 + 4   # + alive,gid + metrics
+    n_in = (len(node_kinds) + len(mb_fields) + 2    # + alive, gid
+            + N_METRIC_LEAVES)
 
     def kernel(t0_ref, *refs):
         in_refs = refs[:n_in]
@@ -1161,7 +1227,8 @@ def _build_kernel(cfg, n_ticks):
         alive_prev = next(it)[:] != 0
         g = next(it)[:]
         met = KMetrics(committed=next(it)[:], leaderless=next(it)[:],
-                       elections=next(it)[:], max_latency=next(it)[:])
+                       elections=next(it)[:], max_latency=next(it)[:],
+                       hist=next(it)[:])
         nodes = PerNode(**nd)
         mailbox = Mailbox(**md)
         t0 = t0_ref[0]
@@ -1207,6 +1274,7 @@ def _build_kernel(cfg, n_ticks):
         next(ot)[:] = met.leaderless
         next(ot)[:] = met.elections
         next(ot)[:] = met.max_latency
+        next(ot)[:] = met.hist
 
     return kernel
 
@@ -1239,7 +1307,7 @@ def _prun_padded(cfg, leaves, t0, n_ticks, interpret=False):
         out_specs=out_specs,
         interpret=interpret,
         compiler_params=None if interpret else pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
+            vmem_limit_bytes=VMEM_LIMIT_BYTES),
     )(t0a, *leaves)
 
 
@@ -1270,9 +1338,13 @@ def kinit(cfg: RaftConfig, st: State, metrics: Metrics | None = None):
     else:
         stp, mc, ml = st, metrics.committed, metrics.leaderless
     leaves = _to_kstate(cfg, stp)
+    # elections / max_latency / hist accumulate from zero in-kernel;
+    # kfinish folds the caller's metrics_base back in (scalars add,
+    # histograms add bucket-wise), so nothing of `metrics` is lost.
     mleaves = [_fold_g(mc), _fold_g(ml),
                _fold_g(jnp.zeros(g + pad, I32)),
-               _fold_g(jnp.zeros(g + pad, I32))]
+               _fold_g(jnp.zeros(g + pad, I32)),
+               _fold_g(jnp.zeros((metrics.hist.shape[0], g + pad), I32))]
     return tuple(leaves + mleaves), g
 
 
@@ -1285,14 +1357,23 @@ def kstep(cfg: RaftConfig, leaves, t0: int, n_ticks: int,
                               interpret=interpret))
 
 
-N_METRIC_LEAVES = 4
+METRIC_LEAVES = ("committed", "leaderless", "elections", "max_latency",
+                 "hist")   # wire order of the metric tail; hist LAST
+N_METRIC_LEAVES = len(METRIC_LEAVES)
+
+
+def _mleaf(leaves, name: str):
+    """The named metric leaf of a wire tuple — indexed by METRIC_LEAVES
+    position, so appending a future leaf cannot silently shift the
+    counters the bench reads (kcommitted/kelections/khist)."""
+    return leaves[METRIC_LEAVES.index(name) - N_METRIC_LEAVES]
 
 
 def kcommitted(leaves, g: int) -> int:
     """Host-side total committed rounds from the wire form (int64 sum —
     run.total_rounds semantics)."""
     import numpy as np
-    mc = np.asarray(_unfold_g(leaves[-N_METRIC_LEAVES]))[:g]
+    mc = np.asarray(_unfold_g(_mleaf(leaves, "committed")))[:g]
     return int(mc.astype(np.int64).sum())
 
 
@@ -1307,25 +1388,39 @@ def kreads(leaves, g: int) -> int:
 
 def kelections(leaves, g: int) -> int:
     import numpy as np
-    me = np.asarray(_unfold_g(leaves[-2]))[:g]
+    me = np.asarray(_unfold_g(_mleaf(leaves, "elections")))[:g]
     return int(me.astype(np.int64).sum())
+
+
+def khist(leaves, g: int):
+    """Host-side election-latency histogram from the wire form: the
+    per-group [H, G] accumulators of the real groups, reduced to the
+    run.Metrics [H] layout (i32 sum, matching the kernel's and the XLA
+    scatter-add's dtype — exact in any order). kfinish folds this into
+    its returned Metrics."""
+    import numpy as np
+    mh = np.asarray(_unfold_g(_mleaf(leaves, "hist")))[:, :g]
+    return mh.sum(axis=1, dtype=np.int32)
 
 
 def kfinish(cfg: RaftConfig, leaves, g: int,
             metrics_base: Metrics | None = None):
-    """Wire form -> (State, Metrics). `metrics_base` supplies the
-    histogram and prior elections/max_latency scalars to fold in (the
-    kernel tracks no histogram — module docstring)."""
+    """Wire form -> (State, Metrics). `metrics_base` supplies prior
+    elections/max_latency scalars and histogram counts to fold in —
+    continuation semantics identical to passing `metrics` to run.run.
+    The histogram is REAL: per-group in-kernel accumulators reduced
+    over groups (bit-identical to the XLA scatter-add)."""
     from raft_tpu.sim.run import metrics_init
     if metrics_base is None:
         metrics_base = metrics_init(g)
     n_state = len(leaves) - N_METRIC_LEAVES
     st = _from_kstate(cfg, [_unfold_g(a) for a in leaves[:n_state]], g)
-    mc, ml, me, mx = [_unfold_g(a)[:g] for a in leaves[n_state:]]
+    mc, ml, me, mx = [_unfold_g(_mleaf(leaves, n))[:g]
+                      for n in METRIC_LEAVES[:4]]
     met = Metrics(
         committed=mc, leaderless=ml,
         elections=metrics_base.elections + jnp.sum(me),
-        hist=metrics_base.hist,
+        hist=metrics_base.hist + khist(leaves, g),
         max_latency=jnp.maximum(metrics_base.max_latency, jnp.max(mx)),
     )
     return st, met
@@ -1334,11 +1429,14 @@ def kfinish(cfg: RaftConfig, leaves, g: int,
 def prun(cfg: RaftConfig, st: State, n_ticks: int, t0: int = 0,
          metrics: Metrics | None = None, interpret: bool = False):
     """Drop-in for `sim.run.run` on supported configs: same (State,
-    Metrics) out, same bits, except Metrics.hist stays zero (use the
-    XLA path when the latency histogram is wanted). One launch + both
-    conversions — for chunked loops use kinit/kstep/kfinish directly."""
+    Metrics) out, same bits — latency histogram included. One launch +
+    both conversions — for chunked loops use kinit/kstep/kfinish
+    directly. Raises ValueError on unsupported shapes (supported())."""
     if not supported(cfg):
-        raise ValueError("pkernel: config needs the XLA path (run.run)")
+        raise ValueError(
+            "pkernel: shape unsupported (k > 30 or VMEM footprint "
+            f"{kernel_vmem_bytes(cfg)} B > {VMEM_LIMIT_BYTES} B) — "
+            "use the XLA path (run.run)")
     leaves, g = kinit(cfg, st, metrics)
     leaves = kstep(cfg, leaves, t0, n_ticks, interpret=interpret)
     return kfinish(cfg, leaves, g, metrics)

@@ -17,6 +17,13 @@ Metrics:
   is always detectable: `latency_censored(hist, q)` says whether the
   q-quantile hit the absorbing bucket. p50/p99 are computed host-side
   from the histogram (`latency_quantile`).
+
+Both engines fold the same metrics every tick: this scanned path
+scatter-adds into the global histogram directly; the Pallas fused-chunk
+kernel (sim/pkernel.py) accumulates per-group histogram lanes in-kernel
+and reduces them over groups at kfinish — bit-identical, since i32 adds
+reassociate exactly (held by tests/test_pkernel.py and bench.py's
+in-run fault-segment differentials).
 """
 
 from __future__ import annotations

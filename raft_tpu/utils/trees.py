@@ -1,0 +1,30 @@
+"""Byte-identical pytree comparison — the ONE definition behind every
+engine-differential gate (test suite, bench promotion, kernel sweep).
+Semantic changes here (dtype sensitivity, NaN handling) propagate to
+all gates at once instead of drifting between hand-rolled copies."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def trees_equal(a, b) -> bool:
+    """True iff the two pytrees have the same leaf count and every leaf
+    pair is byte-identical (np.array_equal)."""
+    ok, _ = trees_equal_why(a, b)
+    return ok
+
+
+def trees_equal_why(a, b, names=None):
+    """(equal, why) — like `trees_equal`, but `why` names the first
+    divergent leaf (via `names`, e.g. a NamedTuple's `_fields`) or the
+    leaf-count mismatch, for diagnostics."""
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    if len(la) != len(lb):
+        return False, f"leaf count {len(la)} != {len(lb)}"
+    for n, (x, y) in enumerate(zip(la, lb)):
+        if not np.array_equal(np.asarray(x), np.asarray(y)):
+            label = names[n] if names and n < len(names) else f"leaf {n}"
+            return False, f"first divergent leaf: {label}"
+    return True, ""

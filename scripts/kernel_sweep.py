@@ -1,0 +1,146 @@
+"""Kernel feature-mix fuzz sweep: the DESIGN.md §7a differential as a
+runnable artifact (VERDICT r05 Missing #2 — the original sweep was run
+ad hoc and committed as prose; evidence that cannot be re-run decays
+the moment the code changes).
+
+For each universe the Pallas fused-chunk engine (sim/pkernel.py) and
+the XLA scan path (sim.run) simulate the SAME config+seed and must end
+bit-identical on the FULL State pytree and the FULL Metrics pytree
+(committed / leaderless / elections / latency histogram / max_latency).
+Any divergence prints the universe and exits nonzero.
+
+Universe construction: k cycles {3, 4, 5} and L cycles {16, 32} across
+a 6-row pairwise covering array over the five feature/fault factors
+(prevote x reconfig x transfer x scheduled-reads x partition) — every
+unordered factor pair exhibits all four on/off combinations somewhere
+in the sweep (asserted at startup, so the covering property cannot
+silently rot). All universes carry baseline crash + drop churn so
+elections, truncations, and the fast-backup path actually execute.
+
+Run on the real TPU (the driver's job):
+    python scripts/kernel_sweep.py
+CPU smoke (interpret mode, small shape — minutes per universe):
+    python scripts/kernel_sweep.py --interpret --groups 8 --ticks 48
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # runnable as `python scripts/...`
+
+import jax
+
+from raft_tpu import sim
+from raft_tpu.config import RaftConfig
+from raft_tpu.sim import pkernel
+from raft_tpu.sim.run import metrics_init, run
+from raft_tpu.utils.trees import trees_equal_why
+
+# Factor order: (prevote, reconfig, transfer, reads, partition).
+# 6-row pairwise covering array over 5 boolean factors (verified by
+# _check_pairwise at startup).
+FACTORS = ("prevote", "reconfig", "transfer", "reads", "partition")
+ROWS = (
+    (0, 0, 0, 0, 0),
+    (1, 1, 1, 1, 1),
+    (1, 1, 0, 0, 1),
+    (1, 0, 1, 1, 0),
+    (0, 1, 1, 0, 0),
+    (0, 0, 0, 1, 1),
+)
+
+
+def _check_pairwise(rows):
+    for i, j in itertools.combinations(range(len(FACTORS)), 2):
+        seen = {(r[i], r[j]) for r in rows}
+        if len(seen) != 4:
+            raise AssertionError(
+                f"covering array broken: factors {FACTORS[i]} x "
+                f"{FACTORS[j]} only hit {sorted(seen)}")
+
+
+def sweep_configs(base_seed: int):
+    """The 6 sweep universes: k in {3,4,5} and L in {16,32} cycle
+    across the covering-array rows, seeds derived from base_seed."""
+    ks = (3, 4, 5)
+    ls = (16, 32)
+    for n, row in enumerate(ROWS):
+        prevote, reconfig, transfer, reads, partition = row
+        yield RaftConfig(
+            seed=base_seed + n,
+            k=ks[n % 3],
+            log_cap=ls[n % 2],
+            prevote=bool(prevote),
+            reconfig_prob=0.8 if reconfig else 0.0, reconfig_epoch=16,
+            transfer_prob=0.7 if transfer else 0.0, transfer_epoch=24,
+            read_every=4 if reads else 0,
+            partition_prob=0.2 if partition else 0.0, partition_epoch=16,
+            crash_prob=0.15, crash_epoch=24, drop_prob=0.04,
+        )
+
+
+def run_universe(cfg: RaftConfig, n_groups: int, ticks: int,
+                 interpret: bool):
+    """(ok, detail, seconds) for one universe's kernel-vs-XLA check."""
+    t0 = time.perf_counter()
+    st0 = sim.init(cfg, n_groups=n_groups)
+    stx, mx = run(cfg, st0, ticks, 0, metrics_init(n_groups))
+    stp, mp = pkernel.prun(cfg, st0, ticks, interpret=interpret)
+    s_ok, s_why = trees_equal_why(stx, stp)
+    m_ok, m_why = trees_equal_why(
+        mx, mp, names=list(type(mx)._fields))
+    dt = time.perf_counter() - t0
+    if s_ok and m_ok:
+        return True, "bit-identical (state + metrics incl. histogram)", dt
+    return False, f"state: {s_why or 'ok'}; metrics: {m_why or 'ok'}", dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--groups", type=int, default=512)
+    ap.add_argument("--ticks", type=int, default=120)
+    ap.add_argument("--seed", type=int, default=1000,
+                    help="base seed; universe n uses seed+n")
+    ap.add_argument("--interpret", action="store_true",
+                    help="pallas interpret mode (CPU smoke; no TPU)")
+    args = ap.parse_args()
+    _check_pairwise(ROWS)
+
+    dev = jax.devices()[0]
+    print(f"platform: {dev.platform} ({dev.device_kind}); "
+          f"{args.groups} groups x {args.ticks} ticks per universe",
+          file=sys.stderr, flush=True)
+    if not args.interpret and dev.platform != "tpu":
+        print("no TPU attached: pass --interpret (and a small "
+              "--groups/--ticks) for a CPU smoke", file=sys.stderr)
+        return 2
+
+    failures = 0
+    for n, cfg in enumerate(sweep_configs(args.seed)):
+        feats = "+".join(f for f, on in zip(FACTORS, ROWS[n]) if on) \
+            or "faults-only"
+        if not pkernel.supported(cfg):
+            print(f"[{n}] k={cfg.k} L={cfg.log_cap} {feats}: UNSUPPORTED "
+                  f"shape (skipped)", flush=True)
+            continue
+        ok, detail, dt = run_universe(cfg, args.groups, args.ticks,
+                                      args.interpret)
+        tag = "ok" if ok else "DIVERGED"
+        print(f"[{n}] seed={cfg.seed} k={cfg.k} L={cfg.log_cap} "
+              f"{feats}: {tag} — {detail} ({dt:.1f}s)", flush=True)
+        failures += 0 if ok else 1
+    if failures:
+        print(f"{failures} universe(s) DIVERGED", file=sys.stderr)
+        return 1
+    print("sweep clean: every universe bit-identical", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,8 +14,12 @@ from __future__ import annotations
 
 import argparse
 import functools
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # runnable as `python scripts/...`
 
 import jax
 import jax.numpy as jnp

@@ -32,9 +32,6 @@ jax.config.update("jax_compilation_cache_dir", _cache)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
-def trees_equal(a, b) -> bool:
-    """Byte-identical pytree comparison (leaf-count mismatch is a fail)."""
-    import numpy as np
-    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
-    return len(la) == len(lb) and all(
-        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb))
+# Re-exported for the tests (import must follow the jax env setup
+# above — raft_tpu.utils.trees imports jax at module level).
+from raft_tpu.utils.trees import trees_equal  # noqa: E402, F401

@@ -197,6 +197,54 @@ def test_differential_transfer_reconfig():
     run_lockstep(cfg, n_groups=2, ticks=500)
 
 
+def test_differential_multi_source_ae_tick():
+    """Same-tick AppendEntries from TWO different senders at one
+    receiver — a partition-heal window where the deposed leader's
+    heartbeat lands alongside the new leader's. Message delivery is
+    SEQUENTIAL per inbox: the second AE must observe the first one's
+    log writes, which is exactly the cross-sender dependency that
+    forbids hoisting receiver-ring reads across senders in the fused
+    kernel handler (sim/pkernel.py `_on_ae_req`) — so this universe
+    pins the semantics at the step-vs-oracle layer where any wrongly
+    "shared" restructure of the entry walk would drift. The probe
+    wraps delivery to prove the scenario actually occurs (the seed was
+    chosen for it); without it the coverage claim would be vacuous."""
+    from raft_tpu.core import rpc
+    cfg = RaftConfig(seed=15, k=3, log_cap=8, compact_every=4,
+                     crash_prob=0.2, crash_epoch=40,
+                     partition_prob=0.6, partition_epoch=40,
+                     drop_prob=0.05)
+    n_groups, ticks = 2, 400
+    multi_ae_ticks = 0
+    clusters = []
+    for g in range(n_groups):
+        c = Cluster(cfg, group=g)
+        orig = c.transport.deliver
+
+        def deliver(t, alive, _orig=orig):
+            nonlocal multi_ae_ticks
+            inboxes = _orig(t, alive)
+            for ib in inboxes:
+                if len({m.src for m in ib if m.type == rpc.AE_REQ}) >= 2:
+                    multi_ae_ticks += 1
+            return inboxes
+
+        c.transport.deliver = deliver
+        clusters.append(c)
+    cpu = {f: np.zeros((ticks, n_groups, cfg.k), np.int64)
+           for f in ALL_FIELDS}
+    for t in range(ticks):
+        for g, c in enumerate(clusters):
+            c.tick()
+            for k, view in enumerate(c.snapshot()):
+                for f in ALL_FIELDS:
+                    cpu[f][t, g, k] = getattr(view, f)
+    assert multi_ae_ticks >= 1, \
+        "no multi-source AE tick occurred - coverage is vacuous"
+    _, jx = trace(cfg, sim.init(cfg, n_groups=n_groups), ticks)
+    assert_traces_equal(cpu, jx, context="multi-source-AE universe")
+
+
 def test_comparator_has_teeth():
     """Prove the gate detects a single-field single-node single-tick drift:
     corrupt one sim trace cell by one and require a loud failure."""

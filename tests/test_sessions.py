@@ -160,6 +160,82 @@ def test_session_table_survives_snapshot_install():
     assert repaired.sessions[sid] == 21
 
 
+def test_session_payload_range_is_loud():
+    """Out-of-range sid/seq raise ValueError (not assert — asserts are
+    stripped under `python -O`, and an aliased sid would corrupt the
+    exactly-once filter): sid 0x1FF is the reserved REGISTER marker,
+    and seq caps at 1023 — the documented session lifetime limit."""
+    assert C.session_payload(0, 0, 0) == C.SESSION_FLAG
+    ok = C.session_payload(3, C.SESSION_SEQ_MASK, 7)   # last usable seq
+    assert (ok >> C.SESSION_SEQ_SHIFT) & C.SESSION_SEQ_MASK == 1023
+    with pytest.raises(ValueError, match="sid"):
+        C.session_payload(C.SESSION_SID_MASK, 1, 0)    # reserved marker
+    with pytest.raises(ValueError, match="sid"):
+        C.session_payload(-1, 1, 0)
+    with pytest.raises(ValueError, match="lifetime"):
+        C.session_payload(0, C.SESSION_SEQ_MASK + 1, 0)
+    with pytest.raises(ValueError, match="lifetime"):
+        C.session_payload(0, -1, 0)
+
+
+def test_open_session_reproposes_lost_register_ticket():
+    """A REGISTER ticket is lost when it lands on a stale leader at an
+    index where the real quorum has ALREADY committed a different
+    payload: is_committed(ticket) can then never become true, and the
+    old behavior burned the entire tick budget waiting on it.
+    open_session must detect the steal via the commit-identity map and
+    re-propose.
+
+    Construction: isolate leader A (term 1); B wins term 2 and commits
+    a session write S1 at index I; crash B and hand the first
+    open_session iteration to still-alive stale A, whose next index is
+    exactly I (it never saw S1) — the doomed REGISTER. Then crash A /
+    revive B so a healthy term-3 leader exists for the re-proposal."""
+    c = Cluster(_scfg(seed=6))
+    c.run(40)
+    a = c.leader()
+    assert a is not None
+    sid0 = c.open_session()
+    assert sid0 is not None
+    c.run(10)                                  # quiesce: all committed
+    base_idx = c.nodes[a].last_index
+    # Isolate A (it keeps its LEADER role, log frozen at base_idx) and
+    # let B win term 2.
+    c.transport.link_filter = lambda t, s, d: s != a and d != a
+    for _ in range(60):
+        if c.leader() not in (None, a):
+            break
+        c.tick()
+    b = c.leader()
+    assert b is not None and b != a
+    # The competing commit at the doomed index, via the real quorum.
+    s1 = c.propose_seq(sid0, 1, 0x31)
+    assert s1 is not None and _settle(c, s1)
+    doomed_idx = base_idx + 1
+    assert s1[0] == doomed_idx and s1[1] != C.SESSION_REGISTER
+    # One tick with B down (A still up): leader() now resolves to stale
+    # A for open_session's first proposal; from T0 on, A is down and B
+    # is back, so a healthy term-3 leader can form for the retry.
+    t_bdown = c.tick_count
+    c.alive_fn = lambda t, _a=a, _b=b: [
+        (t < t_bdown + 1) if i == _a else
+        (t >= t_bdown + 1) if i == _b else True
+        for i in range(3)]
+    c.tick()
+    assert c.leader() == a                     # the stale-leader window
+    sid = c.open_session(max_ticks=200)
+    assert sid is not None, \
+        "open_session burned its budget on a lost REGISTER ticket"
+    # The re-proposal landed ABOVE the stolen index, on a real leader.
+    assert c._committed[doomed_idx] == s1[1]
+    assert c._session_owner[sid] > doomed_idx
+    # And the session the caller got is live: a write through it folds.
+    c.alive_fn = None
+    c.transport.link_filter = None
+    t1 = c.propose_seq(sid, 1, 0x42)
+    assert t1 is not None and _settle(c, t1, 120)
+
+
 def test_sessions_off_is_inert_and_guarded():
     """sessions=False: a payload that happens to carry bit 29 folds like
     any other (the scheduled workloads' digest streams are untouched).
