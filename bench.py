@@ -14,11 +14,20 @@ BOTH engines — the config-4 shape (leader crashes + partitions + drops
 at 50K groups) and the same fault mix at the 100K config-5 shape
 ("Jepsen-style at 100K", VERDICT r05 weak #4) — promoted to the Pallas
 kernel only when full State AND full Metrics (histogram included, so
-p50/p99 are bit-identical by construction) match the XLA path at the
-same tick; every promoted kernel segment carries `state_identical` in
-the JSON. The config-2 shape — pure leader-election rounds, no client
-commands — reports elections/sec at 10K groups under constant crash
-churn. Per-phase detail goes to stderr.
+p50/p99 are bit-identical by construction) AND the flight-recorder ring
+match the XLA path at the same tick; every promoted kernel segment
+carries `state_identical` in the JSON. The config-2 shape — pure
+leader-election rounds, no client commands — reports elections/sec at
+10K groups under constant crash churn. Per-phase detail goes to stderr.
+
+Observability (DESIGN.md §8): both engines fold the per-tick safety bit
+(every segment is a groups x ticks x k node-tick soak; `safety_ok` per
+segment and globally in the JSON), both carry the on-device flight
+recorder (dumped on any gate failure or safety violation), warmup
+(compile-inclusive) and steady-state walls are separate fields
+everywhere, and every segment appends a JSONL provenance manifest
+(config hash, jax/jaxlib versions, device, wall split, verdicts) to
+$RAFT_TPU_MANIFEST or ./bench_manifest.jsonl.
 """
 
 from __future__ import annotations
@@ -33,18 +42,61 @@ import numpy as np
 
 from raft_tpu import sim
 from raft_tpu.config import RaftConfig
+# Observability layer (DESIGN.md §8): flight recorder rides both
+# engines; every segment emits a JSONL provenance manifest.
+from raft_tpu.obs import (dump_flight, emit_manifest, flight_init,
+                          run_recorded)
 from raft_tpu.sim.run import (latency_censored, latency_quantile,
-                              metrics_init, total_rounds)
+                              metrics_init, total_rounds, unsafe_groups)
 # The byte-identical comparator the test suite and kernel sweep gate
 # on, applied at the shapes that produce the headline numbers
-# (VERDICT r05 Missing #1).
-from raft_tpu.utils.trees import trees_equal as _trees_equal
+# (VERDICT r05 Missing #1); the `why` names the first divergent leaf.
+from raft_tpu.utils.trees import trees_equal_why as _trees_equal_why
 
 BASELINE_ROUNDS_PER_SEC = 1_000_000.0
 
 
 def log(msg: str):
     print(msg, file=sys.stderr, flush=True)
+
+
+def _device_str() -> str:
+    dev = jax.devices()[0]
+    return f"{dev.platform}:{dev.device_kind}"
+
+
+def _gate_fields(label: str, pal, m_ref, f_ref, n_groups: int) -> dict:
+    """The verdict/wall fields every steady-state segment shares
+    (throughput / election-rounds / reads): the per-tick safety verdict
+    plus the kernel promotion verdicts and its compile-wall — assembled
+    once so the three segment dicts cannot drift apart."""
+    unsafe = _safety_check(label, m_ref, f_ref, n_groups)
+    return {
+        "state_identical": pal["state_identical"],
+        "metrics_identical": pal["metrics_identical"],
+        "flight_identical": pal["flight_identical"],
+        "pallas_warmup_wall_s": (round(pal["warmup_s"], 3)
+                                 if pal["warmup_s"] is not None else None),
+        "safety_ok": unsafe == 0,
+        "unsafe_groups": unsafe,
+    }
+
+
+def _safety_check(label: str, m, flight=None, n_groups=None) -> int:
+    """Per-tick safety verdict for a finished segment: logs it, dumps
+    the flight recorder on violation, returns the unsafe-group count.
+    Every segment is a (groups x ticks)-node-tick soak now — a
+    violation is reported loudly but must not kill the bench (the JSON
+    line and manifests still have to come out)."""
+    unsafe = unsafe_groups(m)
+    if unsafe == 0:
+        log(f"  [{label}] per-tick safety fold: all groups clean")
+    else:
+        log(f"  [{label}] SAFETY VIOLATION: {unsafe} group(s) dropped the "
+            f"per-tick safety bit")
+        if flight is not None:
+            dump_flight(flight, n_groups, label=label)
+    return unsafe
 
 
 CHUNK = 200   # ticks per device call: one compiled program, reused
@@ -61,49 +113,58 @@ def _timed_chunks(cfg, n_groups: int, ticks: int, counter_fn,
     better than one scan over 10^3+ ticks.)
 
     `counter_fn(st, m) -> int` must read a monotone event counter;
-    returns (rate/s, delta, elapsed_s, timed_ticks, st, m) — the final
-    state/metrics let a caller extend the same universe without
-    re-simulating it from tick 0."""
+    returns (rate/s, delta, elapsed_s, timed_ticks, warmup_s, st, m, f)
+    — the final state/metrics/flight let a caller extend the same
+    universe without re-simulating it from tick 0. `warmup_s` is the
+    compile-inclusive warmup wall; `elapsed_s` is steady-state only —
+    the two are reported as SEPARATE fields everywhere (manifest +
+    bench JSON) so compile cost can never blur into a throughput
+    number. The flight-recorder ring rides the scan in both phases."""
     st = sim.init(cfg, n_groups=n_groups)
     m = metrics_init(n_groups)
+    f = flight_init(n_groups)
     t0 = time.perf_counter()
     tick_at = 0
     for _ in range(warmup_chunks):
-        st, m = sim.run(cfg, st, CHUNK, tick_at, m)
+        st, m, f = run_recorded(cfg, st, CHUNK, tick_at, m, f)
         tick_at += CHUNK
     jax.block_until_ready(st)
-    log(f"  warmup {tick_at} ticks (incl. compile): "
-        f"{time.perf_counter() - t0:.1f}s")
+    warmup_s = time.perf_counter() - t0
+    log(f"  warmup {tick_at} ticks (incl. compile): {warmup_s:.1f}s")
     base = counter_fn(st, m)
     n_chunks = max(1, ticks // CHUNK)
     start = time.perf_counter()
     for _ in range(n_chunks):
-        st, m = sim.run(cfg, st, CHUNK, tick_at, m)
+        st, m, f = run_recorded(cfg, st, CHUNK, tick_at, m, f)
         tick_at += CHUNK
     jax.block_until_ready(st)
     elapsed = time.perf_counter() - start
     delta = counter_fn(st, m) - base
-    return delta / elapsed, delta, elapsed, n_chunks * CHUNK, st, m
+    return (delta / elapsed, delta, elapsed, n_chunks * CHUNK, warmup_s,
+            st, m, f)
 
 
 def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
-                    st_ref, m_ref, what: str):
+                    st_ref, m_ref, f_ref, what: str):
     """Shared Pallas fused-chunk warmup/timing/differential harness
     (the kernel-side analogue of `_timed_chunks`; every steady-state
     kernel segment runs through here so the subtleties stay in one
     place — `bench_fault_latency` carries the same warmup/timing/
     promotion protocol in its from-tick-0 form, where the histogram
     needs every tick and no reference can be extended).
-    Returns (rate, count, elapsed, status, state_identical) with status
+    Returns a dict {rate, count, elapsed, warmup_s, status,
+    state_identical, metrics_identical, flight_identical} with status
     one of "ok" | "mismatch" | "unsupported" | an error string, and
     state_identical the FULL-State pytree comparison against the XLA
     reference at the same tick (None when the kernel never produced a
-    state). Promotion requires the full State pytree AND the full
-    Metrics pytree (committed / leaderless / elections / histogram /
-    max_latency) bit-identical — a counter-blind corruption of terms,
-    logs, or mailbox state demotes the kernel exactly like a counter
-    drift would (VERDICT r05 Missing #1); the per-segment counter is
-    now only the timed quantity, not the differential.
+    state). Promotion requires the full State pytree, the full Metrics
+    pytree (committed / leaderless / elections / histogram /
+    max_latency / safety), AND the flight-recorder ring bit-identical —
+    a counter-blind corruption of terms, logs, or mailbox state demotes
+    the kernel exactly like a counter drift would (VERDICT r05 Missing
+    #1); the per-segment counter is only the timed quantity, not the
+    differential. On mismatch both engines' flight rings are dumped
+    next to the leaf-level report.
 
     Subtleties encoded here, each learned from a wrong measurement:
     - TWO warmup launches: the first compiles for kinit's buffer
@@ -117,20 +178,25 @@ def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
       the kernel's 2*CHUNK + timed_ticks endpoint, then the two
       universes must be bit-identical.
     """
+    fail = dict(rate=None, count=None, elapsed=None, warmup_s=None,
+                state_identical=None, metrics_identical=None,
+                flight_identical=None)
     try:   # kernel failure of ANY kind (incl. import) never kills the bench
         from raft_tpu.sim import pkernel
         if not (pkernel.supported(cfg)
                 and jax.devices()[0].platform == "tpu"):
-            return None, None, None, "unsupported", None
+            return {**fail, "status": "unsupported"}
         counter_fn = getattr(pkernel, counter_name)
-        leaves, g = pkernel.kinit(cfg, sim.init(cfg, n_groups=n_groups))
+        leaves, g = pkernel.kinit(cfg, sim.init(cfg, n_groups=n_groups),
+                                  flight=flight_init(n_groups))
         t0 = time.perf_counter()
         leaves = pkernel.kstep(cfg, leaves, 0, CHUNK)
         counter_fn(leaves, g)                            # forces compile #1
         leaves = pkernel.kstep(cfg, leaves, CHUNK, CHUNK)
         base = counter_fn(leaves, g)                     # forces compile #2
+        warmup_s = time.perf_counter() - t0
         log(f"  [pallas] warmup {2 * CHUNK} ticks (incl. 2 compiles): "
-            f"{time.perf_counter() - t0:.1f}s")
+            f"{warmup_s:.1f}s")
         n_chunks = timed_ticks // CHUNK
         start = time.perf_counter()
         for c in range(n_chunks):
@@ -141,21 +207,37 @@ def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
         log(f"  [pallas] {n_groups} groups x {timed_ticks} ticks: "
             f"{count} {what} in {elapsed:.2f}s -> {rate:,.0f} {what}/s "
             f"({elapsed / timed_ticks * 1e3:.2f} ms/tick)")
-        st_ref, m_ref = sim.run(cfg, st_ref, CHUNK,
-                                CHUNK + timed_ticks, m_ref)
+        st_ref, m_ref, f_ref = run_recorded(cfg, st_ref, CHUNK,
+                                            CHUNK + timed_ticks, m_ref,
+                                            f_ref)
         st_pal, m_pal = pkernel.kfinish(cfg, leaves, g)
-        state_ok = _trees_equal(st_ref, st_pal)
-        metrics_ok = _trees_equal(m_ref, m_pal)
-        if state_ok and metrics_ok:
+        f_pal = pkernel.kflight(cfg, leaves, g)
+        state_ok, s_why = _trees_equal_why(st_ref, st_pal)
+        metrics_ok, m_why = _trees_equal_why(m_ref, m_pal)
+        flight_ok, f_why = _trees_equal_why(f_ref, f_pal)
+        verdicts = dict(state_identical=state_ok,
+                        metrics_identical=metrics_ok,
+                        flight_identical=flight_ok)
+        if state_ok and metrics_ok and flight_ok:
             log("  [pallas] differential vs xla at same tick: full State "
-                "+ full Metrics bit-identical")
-            return rate, count, elapsed, "ok", True
+                "+ full Metrics + flight ring bit-identical")
+            return dict(rate=rate, count=count, elapsed=elapsed,
+                        warmup_s=warmup_s, status="ok", **verdicts)
         log(f"  [pallas] DIFFERENTIAL MISMATCH (state_identical={state_ok} "
-            f"metrics_identical={metrics_ok}) - kernel number discarded")
-        return None, None, None, "mismatch", state_ok
+            f"metrics_identical={metrics_ok} flight_identical={flight_ok})"
+            f" - kernel number discarded")
+        for why in (s_why, m_why, f_why):
+            if why:
+                log(f"  [pallas] {why}")
+        dump_flight(f_ref, label="xla-ref")
+        dump_flight(f_pal, label="pallas")
+        # warmup_s survives: the compile/run split is provenance for
+        # exactly the runs that need triage.
+        return {**fail, **verdicts, "warmup_s": warmup_s,
+                "status": "mismatch"}
     except Exception as e:   # kernel failure must never kill the bench
         log(f"  [pallas] failed ({type(e).__name__}: {e}); xla stands")
-        return None, None, None, f"error: {type(e).__name__}", None
+        return {**fail, "status": f"error: {type(e).__name__}"}
 
 
 def bench_throughput(n_groups: int, ticks: int):
@@ -172,23 +254,34 @@ def bench_throughput(n_groups: int, ticks: int):
     mismatch or kernel failure the XLA number stands and the JSON says
     so (`state_identical` per segment)."""
     cfg = RaftConfig(seed=42)
-    rps, rounds, elapsed, timed_ticks, st_ref, m_ref = _timed_chunks(
-        cfg, n_groups, ticks, lambda st, m: total_rounds(m))
+    (rps, rounds, elapsed, timed_ticks, warmup_s, st_ref, m_ref,
+     f_ref) = _timed_chunks(cfg, n_groups, ticks,
+                            lambda st, m: total_rounds(m))
     log(f"  [xla] {n_groups} groups x {timed_ticks} ticks: {rounds} rounds "
         f"in {elapsed:.2f}s -> {rps:,.0f} rounds/s "
         f"({timed_ticks / elapsed:,.0f} ticks/s)")
     engine = "xla-scan"
-    p_rate, p_count, p_elapsed, status, state_ok = _pallas_segment(
-        cfg, n_groups, timed_ticks, "kcommitted", st_ref, m_ref, "rounds")
-    if status == "ok" and p_rate > rps:
-        rps, rounds, elapsed = p_rate, p_count, p_elapsed
+    pal = _pallas_segment(cfg, n_groups, timed_ticks, "kcommitted",
+                          st_ref, m_ref, f_ref, "rounds")
+    if pal["status"] == "ok" and pal["rate"] > rps:
+        rps, rounds, elapsed = pal["rate"], pal["count"], pal["elapsed"]
         engine = "pallas-fused-chunk"
-    elif status == "mismatch":
+    elif pal["status"] == "mismatch":
         engine = "xla-scan (pallas mismatch!)"
-    pallas_rps = p_rate if status == "ok" else None
-    pallas_ms = (p_elapsed / timed_ticks * 1e3) if status == "ok" else None
-    return rps, rounds, elapsed, timed_ticks, engine, pallas_rps, \
-        pallas_ms, state_ok
+    ok = pal["status"] == "ok"
+    seg = {
+        "rounds_per_sec": round(rps, 1), "rounds": rounds,
+        "ticks": timed_ticks, "engine": engine,
+        "timed_wall_s": round(elapsed, 3),
+        "xla_warmup_wall_s": round(warmup_s, 3),
+        "pallas_rounds_per_sec": round(pal["rate"], 1) if ok else None,
+        "pallas_ms_per_tick": (round(pal["elapsed"] / timed_ticks * 1e3, 3)
+                               if ok else None),
+        **_gate_fields("throughput", pal, m_ref, f_ref, n_groups),
+    }
+    emit_manifest("throughput", cfg, device=_device_str(),
+                  n_groups=n_groups, **seg)
+    return seg
 
 
 def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
@@ -211,16 +304,19 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
     # --- XLA reference: warm the compile on a throwaway universe, then
     # time the real one end-to-end (the histogram needs every tick).
     t0 = time.perf_counter()
-    wst, wm = sim.run(cfg, sim.init(cfg, n_groups=n_groups), CHUNK, 0,
-                      metrics_init(n_groups))
+    wst, wm, wf = run_recorded(cfg, sim.init(cfg, n_groups=n_groups),
+                               CHUNK, 0, metrics_init(n_groups),
+                               flight_init(n_groups))
     jax.block_until_ready(wst)
-    log(f"  [xla] warmup chunk (incl. compile): "
-        f"{time.perf_counter() - t0:.1f}s")
+    x_warmup_s = time.perf_counter() - t0
+    log(f"  [xla] warmup chunk (incl. compile): {x_warmup_s:.1f}s")
     st = sim.init(cfg, n_groups=n_groups)
     m = metrics_init(n_groups)
+    f = flight_init(n_groups)
     start = time.perf_counter()
     for tick_at in range(0, ticks, CHUNK):
-        st, m = sim.run(cfg, st, min(CHUNK, ticks - tick_at), tick_at, m)
+        st, m, f = run_recorded(cfg, st, min(CHUNK, ticks - tick_at),
+                                tick_at, m, f)
     n_elections = int(m.elections)          # fetch closes the timer
     x_elapsed = time.perf_counter() - start
     rounds = total_rounds(m)
@@ -228,7 +324,8 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
         f"{x_elapsed:.2f}s ({x_elapsed / ticks * 1e3:.2f} ms/tick): "
         f"{rounds} rounds, {n_elections} elections")
 
-    engine, k_elapsed, state_ok = "xla-scan", None, None
+    engine, k_elapsed, k_warmup_s = "xla-scan", None, None
+    state_ok = metrics_ok = flight_ok = None
     elapsed = x_elapsed
     try:   # kernel failure of ANY kind never kills the bench
         from raft_tpu.sim import pkernel
@@ -236,14 +333,16 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
             # Warmup on a throwaway universe: compile #1 (kinit
             # layouts) + compile #2 (kernel-chained layouts).
             t0 = time.perf_counter()
-            wl, wg = pkernel.kinit(cfg, sim.init(cfg, n_groups=n_groups))
+            wl, wg = pkernel.kinit(cfg, sim.init(cfg, n_groups=n_groups),
+                                   flight=flight_init(n_groups))
             wl = pkernel.kstep(cfg, wl, 0, CHUNK)
             pkernel.kelections(wl, wg)
             wl = pkernel.kstep(cfg, wl, CHUNK, CHUNK)
             pkernel.kelections(wl, wg)
-            log(f"  [pallas] warmup (incl. 2 compiles): "
-                f"{time.perf_counter() - t0:.1f}s")
-            leaves, g = pkernel.kinit(cfg, sim.init(cfg, n_groups=n_groups))
+            k_warmup_s = time.perf_counter() - t0
+            log(f"  [pallas] warmup (incl. 2 compiles): {k_warmup_s:.1f}s")
+            leaves, g = pkernel.kinit(cfg, sim.init(cfg, n_groups=n_groups),
+                                      flight=flight_init(n_groups))
             start = time.perf_counter()
             at = 0
             while at < ticks:
@@ -253,23 +352,33 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
             pkernel.kelections(leaves, g)   # fetch closes the timer
             k_elapsed = time.perf_counter() - start
             st_pal, m_pal = pkernel.kfinish(cfg, leaves, g)
-            state_ok = _trees_equal(st, st_pal)
-            metrics_ok = _trees_equal(m, m_pal)
+            f_pal = pkernel.kflight(cfg, leaves, g)
+            state_ok, s_why = _trees_equal_why(st, st_pal)
+            metrics_ok, m_why = _trees_equal_why(m, m_pal)
+            flight_ok, f_why = _trees_equal_why(f, f_pal)
             log(f"  [pallas] {label} {n_groups} groups x {ticks} ticks in "
                 f"{k_elapsed:.2f}s ({k_elapsed / ticks * 1e3:.2f} ms/tick)")
-            if state_ok and metrics_ok:
+            if state_ok and metrics_ok and flight_ok:
                 log("  [pallas] differential vs xla at same tick: full "
-                    "State + full Metrics (incl. histogram) bit-identical")
+                    "State + full Metrics (incl. histogram + safety) + "
+                    "flight ring bit-identical")
                 engine, elapsed = "pallas-fused-chunk", k_elapsed
             else:
                 log(f"  [pallas] DIFFERENTIAL MISMATCH (state_identical="
-                    f"{state_ok} metrics_identical={metrics_ok}) - "
+                    f"{state_ok} metrics_identical={metrics_ok} "
+                    f"flight_identical={flight_ok}) - "
                     f"kernel number discarded")
+                for why in (s_why, m_why, f_why):
+                    if why:
+                        log(f"  [pallas] {why}")
+                dump_flight(f, label=f"{label}:xla-ref")
+                dump_flight(f_pal, label=f"{label}:pallas")
                 engine = "xla-scan (pallas mismatch!)"
     except Exception as e:
         log(f"  [pallas] failed ({type(e).__name__}: {e}); xla stands")
         engine = f"xla-scan (pallas error: {type(e).__name__})"
 
+    unsafe = _safety_check(label, m, f, n_groups)
     p50 = latency_quantile(m.hist, 0.5)
     p99 = latency_quantile(m.hist, 0.99)
     censored = latency_censored(m.hist, 0.99)
@@ -283,15 +392,24 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
         f"max={max_lat} ticks"
         f"{' [p99 CENSORED at histogram top bucket]' if censored else ''}"
         f" ({p99_note}); engine={engine}")
-    return {
+    seg = {
         "p50": p50, "p99": p99, "censored": censored, "max_lat": max_lat,
         "p99_note": p99_note, "elections": n_elections, "rounds": rounds,
         "rounds_per_sec": rounds / elapsed, "engine": engine,
-        "state_identical": state_ok, "n_groups": n_groups, "ticks": ticks,
+        "state_identical": state_ok, "metrics_identical": metrics_ok,
+        "flight_identical": flight_ok,
+        "n_groups": n_groups, "ticks": ticks,
         "xla_wall_s": round(x_elapsed, 3),
+        "xla_warmup_wall_s": round(x_warmup_s, 3),
         "kernel_wall_s": (round(k_elapsed, 3)
                           if k_elapsed is not None else None),
+        "kernel_warmup_wall_s": (round(k_warmup_s, 3)
+                                 if k_warmup_s is not None else None),
+        "safety_ok": unsafe == 0, "unsafe_groups": unsafe,
     }
+    emit_manifest(label, cfg, device=_device_str(), **{
+        k: v for k, v in seg.items() if k != "p99_note"})
+    return seg
 
 
 def bench_election_rounds(n_groups: int, ticks: int):
@@ -314,20 +432,29 @@ def bench_election_rounds(n_groups: int, ticks: int):
     election count so under-sampling is visible)."""
     cfg = RaftConfig(seed=44, cmds_per_tick=0, crash_prob=0.5,
                      crash_epoch=32)
-    eps, elections, elapsed, timed_ticks, st_ref, m_ref = _timed_chunks(
-        cfg, n_groups, ticks, lambda st, m: int(m.elections))
+    (eps, elections, elapsed, timed_ticks, warmup_s, st_ref, m_ref,
+     f_ref) = _timed_chunks(cfg, n_groups, ticks,
+                            lambda st, m: int(m.elections))
     log(f"  [xla] election rounds {n_groups} groups x {timed_ticks} ticks: "
         f"{elections} elections in {elapsed:.2f}s -> {eps:,.0f} elections/s")
     engine = "xla-scan"
-    p_rate, p_count, _, status, state_ok = _pallas_segment(
-        cfg, n_groups, timed_ticks, "kelections", st_ref, m_ref,
-        "elections")
-    if status == "ok" and p_rate > eps:
-        eps, elections = p_rate, p_count
+    pal = _pallas_segment(cfg, n_groups, timed_ticks, "kelections",
+                          st_ref, m_ref, f_ref, "elections")
+    if pal["status"] == "ok" and pal["rate"] > eps:
+        eps, elections = pal["rate"], pal["count"]
         engine = "pallas-fused-chunk"
-    elif status == "mismatch":
+    elif pal["status"] == "mismatch":
         engine = "xla-scan (pallas mismatch!)"
-    return eps, elections, engine, state_ok
+    seg = {
+        "elections_per_sec": round(eps, 1), "elections": elections,
+        "engine": engine,
+        "timed_wall_s": round(elapsed, 3),
+        "xla_warmup_wall_s": round(warmup_s, 3),
+        **_gate_fields("election-rounds", pal, m_ref, f_ref, n_groups),
+    }
+    emit_manifest("election-rounds", cfg, device=_device_str(),
+                  n_groups=n_groups, ticks=timed_ticks, **seg)
+    return seg
 
 
 def bench_reads(n_groups: int, ticks: int):
@@ -341,7 +468,8 @@ def bench_reads(n_groups: int, ticks: int):
     Metrics pytree are bit-identical to the XLA path at the same
     tick."""
     cfg = RaftConfig(seed=45, read_every=4)
-    rps, reads, elapsed, timed_ticks, st_ref, m_ref = _timed_chunks(
+    (rps, reads, elapsed, timed_ticks, warmup_s, st_ref, m_ref,
+     f_ref) = _timed_chunks(
         cfg, n_groups, ticks,
         lambda st, m: int(np.asarray(st.nodes.reads_done)
                           .astype(np.int64).sum()))
@@ -349,14 +477,22 @@ def bench_reads(n_groups: int, ticks: int):
         f"ticks (read_every={cfg.read_every}): {reads} reads in "
         f"{elapsed:.2f}s -> {rps:,.0f} reads/s")
     engine = "xla-scan"
-    p_rate, p_count, _, status, state_ok = _pallas_segment(
-        cfg, n_groups, timed_ticks, "kreads", st_ref, m_ref, "reads")
-    if status == "ok" and p_rate > rps:
-        rps, reads = p_rate, p_count
+    pal = _pallas_segment(cfg, n_groups, timed_ticks, "kreads",
+                          st_ref, m_ref, f_ref, "reads")
+    if pal["status"] == "ok" and pal["rate"] > rps:
+        rps, reads = pal["rate"], pal["count"]
         engine = "pallas-fused-chunk"
-    elif status == "mismatch":
+    elif pal["status"] == "mismatch":
         engine = "xla-scan (pallas mismatch!)"
-    return rps, reads, engine, state_ok
+    seg = {
+        "reads_per_sec": round(rps, 1), "reads": reads, "engine": engine,
+        "timed_wall_s": round(elapsed, 3),
+        "xla_warmup_wall_s": round(warmup_s, 3),
+        **_gate_fields("reads", pal, m_ref, f_ref, n_groups),
+    }
+    emit_manifest("reads", cfg, device=_device_str(), n_groups=n_groups,
+                  ticks=timed_ticks, **seg)
+    return seg
 
 
 def main():
@@ -391,33 +527,40 @@ def main():
         rd_groups, rd_ticks = 50_000, 600   # ReadIndex-at-scale segment
 
     log(f"throughput (config-5 shape, {groups} x 5-node groups):")
-    (rps, rounds, elapsed, ticks, engine, pallas_rps, pallas_ms,
-     tp_state_ok) = bench_throughput(groups, ticks)
+    tp = bench_throughput(groups, ticks)
     log("election latency (config-4 shape, both engines):")
     c4 = bench_fault_latency(43, e_groups, e_ticks, "config-4 fault run")
     log("fault-mix throughput + latency (config-5 shape, both engines):")
     c5f = bench_fault_latency(46, f_groups, f_ticks, "config-5 fault mix")
     log("election rounds (config-2 shape):")
-    eps, n_c2_elections, c2_engine, c2_state_ok = bench_election_rounds(
-        r_groups, r_ticks)
+    c2 = bench_election_rounds(r_groups, r_ticks)
     log("linearizable reads (config-5 shape + ReadIndex schedule):")
-    reads_ps, n_reads, reads_engine, rd_state_ok = bench_reads(
-        rd_groups, rd_ticks)
+    rd = bench_reads(rd_groups, rd_ticks)
 
+    safety_ok = all(s["safety_ok"] for s in (tp, c4, c5f, c2, rd))
+    if not safety_ok:
+        log("SAFETY: at least one segment dropped the per-tick safety "
+            "bit — see the flight-recorder dumps above")
     print(json.dumps({
         "metric": "consensus_rounds_per_sec_per_chip",
-        "value": round(rps, 1),
+        "value": tp["rounds_per_sec"],
         "unit": "rounds/s",
-        "vs_baseline": round(rps / BASELINE_ROUNDS_PER_SEC, 3),
+        "vs_baseline": round(tp["rounds_per_sec"]
+                             / BASELINE_ROUNDS_PER_SEC, 3),
         "n_groups": groups,
-        "ticks": ticks,
-        "wall_s": round(elapsed, 3),
-        "engine": engine,
-        "pallas_rounds_per_sec": (round(pallas_rps, 1)
-                                  if pallas_rps is not None else None),
-        "pallas_ms_per_tick": (round(pallas_ms, 3)
-                               if pallas_ms is not None else None),
-        "throughput_state_identical": tp_state_ok,
+        "ticks": tp["ticks"],
+        "wall_s": tp["timed_wall_s"],
+        "warmup_wall_s": tp["xla_warmup_wall_s"],
+        "engine": tp["engine"],
+        "pallas_rounds_per_sec": tp["pallas_rounds_per_sec"],
+        "pallas_ms_per_tick": tp["pallas_ms_per_tick"],
+        "pallas_warmup_wall_s": tp["pallas_warmup_wall_s"],
+        "throughput_state_identical": tp["state_identical"],
+        "throughput_safety_ok": tp["safety_ok"],
+        # Per-tick safety fold (DESIGN.md §8): every segment is a
+        # (groups x ticks x k)-node-tick soak; True = no group violated
+        # election safety / digest agreement / window bounds at ANY tick.
+        "safety_ok": safety_ok,
         "p50_election_latency_ticks": c4["p50"],
         "p99_election_latency_ticks": c4["p99"],
         "p99_censored": c4["censored"],
@@ -426,8 +569,11 @@ def main():
         "elections_observed": c4["elections"],
         "config4_engine": c4["engine"],
         "config4_state_identical": c4["state_identical"],
+        "config4_safety_ok": c4["safety_ok"],
         "config4_xla_wall_s": c4["xla_wall_s"],
+        "config4_xla_warmup_wall_s": c4["xla_warmup_wall_s"],
         "config4_kernel_wall_s": c4["kernel_wall_s"],
+        "config4_kernel_warmup_wall_s": c4["kernel_warmup_wall_s"],
         "faulted_rounds_per_sec": round(c5f["rounds_per_sec"], 1),
         "faulted_p50_election_latency_ticks": c5f["p50"],
         "faulted_p99_election_latency_ticks": c5f["p99"],
@@ -436,17 +582,22 @@ def main():
         "config5_fault_n_groups": c5f["n_groups"],
         "config5_fault_engine": c5f["engine"],
         "config5_fault_state_identical": c5f["state_identical"],
+        "config5_fault_safety_ok": c5f["safety_ok"],
         "config5_fault_xla_wall_s": c5f["xla_wall_s"],
+        "config5_fault_xla_warmup_wall_s": c5f["xla_warmup_wall_s"],
         "config5_fault_kernel_wall_s": c5f["kernel_wall_s"],
-        "elections_per_sec": round(eps, 1),
-        "config2_elections_observed": n_c2_elections,
-        "config2_engine": c2_engine,
-        "config2_state_identical": c2_state_ok,
+        "config5_fault_kernel_warmup_wall_s": c5f["kernel_warmup_wall_s"],
+        "elections_per_sec": c2["elections_per_sec"],
+        "config2_elections_observed": c2["elections"],
+        "config2_engine": c2["engine"],
+        "config2_state_identical": c2["state_identical"],
+        "config2_safety_ok": c2["safety_ok"],
         "config2_note": "schedule-bound rate; see bench_election_rounds",
-        "linearizable_reads_per_sec": round(reads_ps, 1),
-        "reads_observed": n_reads,
-        "reads_engine": reads_engine,
-        "reads_state_identical": rd_state_ok,
+        "linearizable_reads_per_sec": rd["reads_per_sec"],
+        "reads_observed": rd["reads"],
+        "reads_engine": rd["engine"],
+        "reads_state_identical": rd["state_identical"],
+        "reads_safety_ok": rd["safety_ok"],
         "device": f"{dev.platform}:{dev.device_kind}",
     }))
 

@@ -1,0 +1,30 @@
+"""Observability layer over both engines (DESIGN.md §8).
+
+Four pieces, one evidence chain:
+
+- **Per-tick safety fold** — `check.tick_safety` ANDed into
+  `Metrics.safety` every tick by `run.metrics_update` and, on the
+  Pallas path, in-kernel by `pkernel._safety_tick` (a host readback
+  would dominate the tick; the in-kernel fold is a few vreg compares).
+- **Flight recorder** (`obs.recorder`) — a fixed-size on-device ring of
+  per-tick per-group aggregates captured by both engines and dumped
+  host-side on any gate failure.
+- **Divergence triage** (`obs.triage`) — chunk-boundary re-execution
+  that bisects two engine trajectories to the first divergent tick,
+  then names the first divergent leaf (utils.trees).
+- **Run manifests** (`obs.manifest`) — every bench segment appends one
+  JSONL provenance record (config hash, versions, device, compile-vs-
+  run wall split, safety/identity verdicts).
+"""
+
+from raft_tpu.obs.manifest import config_hash, emit_manifest
+from raft_tpu.obs.recorder import (FLIGHT_LEAVES, RING, Flight, dump_flight,
+                                   flight_init, flight_rows, flight_update,
+                                   run_recorded)
+from raft_tpu.obs.triage import bisect_divergence
+
+__all__ = [
+    "FLIGHT_LEAVES", "RING", "Flight", "bisect_divergence", "config_hash",
+    "dump_flight", "emit_manifest", "flight_init", "flight_rows",
+    "flight_update", "run_recorded",
+]

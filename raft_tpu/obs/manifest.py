@@ -1,0 +1,75 @@
+"""Structured run manifests: one JSONL record per bench segment, so
+BENCH_r0N numbers carry their own provenance instead of relying on the
+session log that produced them (DESIGN.md §8).
+
+Schema (one JSON object per line; `schema` bumps on breaking change):
+
+    schema        1
+    segment       segment name, e.g. "throughput" / "config4-faults"
+    unix_time     emission time (host clock, seconds)
+    config_hash   first 12 hex chars of sha256 over the canonical
+                  (sort_keys) JSON of the RaftConfig dataclass
+    config        the full RaftConfig dict the hash covers
+    jax, jaxlib   library versions
+    device        "platform:device_kind" of jax.devices()[0]
+    ...           caller fields: engine, warmup_wall_s / timed_wall_s
+                  (the compile-vs-run split), rates, state_identical /
+                  metrics_identical / flight_identical verdicts,
+                  safety_ok + unsafe_groups, counters
+
+Destination: $RAFT_TPU_MANIFEST if set, else ./bench_manifest.jsonl,
+appended — a bench run leaves one record per segment beside its JSON
+line. Pass path="-" to skip the write (the record is still returned).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+
+MANIFEST_ENV = "RAFT_TPU_MANIFEST"
+DEFAULT_PATH = "bench_manifest.jsonl"
+
+
+def config_hash(cfg) -> str:
+    """Stable short hash of the semantic config — two runs with equal
+    hashes simulated the same universe schedule (same seed included)."""
+    blob = json.dumps(dataclasses.asdict(cfg), sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def _versions():
+    try:
+        import jax
+        jv = jax.__version__
+    except Exception:
+        jv = None
+    try:
+        import jaxlib
+        jlv = jaxlib.__version__
+    except Exception:
+        jlv = None
+    return jv, jlv
+
+
+def emit_manifest(segment: str, cfg, device: str | None = None,
+                  path: str | None = None, **fields) -> dict:
+    """Append one manifest record for `segment` under `cfg`; returns the
+    record. Caller passes `device` (emit never probes jax.devices()
+    itself — probing can initialize a backend the caller deliberately
+    avoided) and any extra fields."""
+    jv, jlv = _versions()
+    rec = {"schema": 1, "segment": segment,
+           "unix_time": round(time.time(), 3),
+           "config_hash": config_hash(cfg),
+           "config": dataclasses.asdict(cfg),
+           "jax": jv, "jaxlib": jlv, "device": device}
+    rec.update(fields)
+    path = path or os.environ.get(MANIFEST_ENV) or DEFAULT_PATH
+    if path != "-":
+        with open(path, "a") as fh:
+            fh.write(json.dumps(rec, sort_keys=True) + "\n")
+    return rec

@@ -1,0 +1,174 @@
+"""Flight recorder: a fixed-size on-device ring of per-tick per-group
+aggregates, captured by BOTH engines and dumped host-side on any gate
+failure (DESIGN.md §8).
+
+Between bench boundaries the fleet used to be a black box: a failed
+`state_identical` gate said nothing about WHEN behavior went strange.
+The ring keeps the last `RING` ticks of six aggregate signals per
+group — absolute tick, alive-leader count, election-completion bit,
+max commit index, message volume, and the per-tick safety bit — so a
+failure report comes with the recent aggregate history attached.
+
+Capture is per-GROUP (no cross-group reduction on device): slot
+`t % RING` of each `[RING, G]` ring is overwritten every tick. The
+Pallas kernel writes the identical values into `[RING, GS, 128]` lanes
+(sim/pkernel.py `_metrics_tick`), so the two engines' rings are
+bit-comparable like every other gate surface; reduction over groups
+happens host-side at dump time (i32 sums — exact in any order).
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.core.node import LEADER
+from raft_tpu.sim import check
+from raft_tpu.sim.run import Metrics, metrics_init, metrics_update
+from raft_tpu.sim.state import I32, State
+from raft_tpu.sim.step import tick
+
+RING = 64   # ticks of history; slot t % RING holds tick t
+
+# Field order of `Flight` — the kernel wire appends these leaves in this
+# exact order (scripts/check_metric_parity.py pins the two).
+FLIGHT_LEAVES = ("tick", "leaders", "elections", "commit", "msgs", "safety")
+
+# Mailbox occupancy fields, in the order both engines sum them for the
+# message-volume signal (i32 adds are exact in any order; fixing the
+# order keeps the two folds textually parallel). PreVote/TimeoutNow
+# slots are skipped when their schedules are off (leaf is None / absent).
+PRESENCE_FIELDS = ("rv_req_present", "rv_resp_present", "ae_req_present",
+                   "ae_resp_present", "is_req_present", "is_resp_present",
+                   "pv_req_present", "pv_resp_present", "tn_present")
+
+
+class Flight(NamedTuple):
+    """Per-group ring buffers, i32[RING, G] each ([RING, GS, 128] on the
+    kernel wire). Slot s holds the most recent tick t with t % RING == s."""
+
+    tick: jnp.ndarray       # absolute tick recorded in the slot; -1 = never
+    leaders: jnp.ndarray    # alive leaders in the group that tick
+    elections: jnp.ndarray  # 1 iff the group completed an election that tick
+    commit: jnp.ndarray     # max commit index over the group's nodes
+    msgs: jnp.ndarray       # messages in flight out of that tick
+    safety: jnp.ndarray     # that tick's safety bit (1 = invariants held)
+
+
+def flight_init(n_groups: int, ring: int = RING) -> Flight:
+    z = jnp.zeros((ring, n_groups), I32)
+    return Flight(tick=jnp.full((ring, n_groups), -1, I32), leaders=z,
+                  elections=z, commit=z, msgs=z, safety=z)
+
+
+def message_volume(st: State):
+    """i32[G]: occupied mailbox slots after the tick — this tick's sends,
+    post dead-sender erasure. The kernel mirrors this field order."""
+    total = None
+    for f in PRESENCE_FIELDS:
+        p = getattr(st.mailbox, f)
+        if p is None:
+            continue
+        v = jnp.sum(jnp.sum(p.astype(I32), axis=-1), axis=-1)
+        total = v if total is None else total + v
+    return total
+
+
+def flight_update(cfg: RaftConfig, f: Flight, st: State, m_prev: Metrics,
+                  t) -> Flight:
+    """Record tick `t`'s aggregates into ring slot t % RING (overwrite).
+    `m_prev` is the metrics BEFORE this tick's fold — the election event
+    bit is derived from the previous leaderless streak, exactly as
+    `metrics_update` derives it."""
+    nodes = st.nodes
+    ring = f.tick.shape[0]
+    on = (jnp.arange(ring, dtype=I32)[:, None] == t % ring)   # [RING, 1]
+
+    leaders = jnp.sum(((nodes.role == LEADER) & st.alive_prev).astype(I32),
+                      axis=1)
+    done = ((leaders > 0) & (m_prev.leaderless > 0)).astype(I32)
+    commit = jnp.max(nodes.commit, axis=1)
+    msgs = message_volume(st)
+    safe = check.tick_safety(st, cfg.log_cap).astype(I32)
+
+    def w(r, val):
+        return jnp.where(on, val[None, :], r)
+
+    return Flight(tick=jnp.where(on, t, f.tick),
+                  leaders=w(f.leaders, leaders),
+                  elections=w(f.elections, done),
+                  commit=w(f.commit, commit),
+                  msgs=w(f.msgs, msgs),
+                  safety=w(f.safety, safe))
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def run_recorded(cfg: RaftConfig, st: State, n_ticks: int, t0=0,
+                 metrics: Metrics | None = None,
+                 flight: Flight | None = None):
+    """`sim.run.run` with the flight recorder riding the scan: returns
+    (state, metrics, flight). The state/metrics bits are identical to
+    run.run's — the ring fold only READS the post-tick state, never
+    feeds back. Chunked drivers pass the returned metrics/flight back
+    in to continue the same recording."""
+    if metrics is None:
+        metrics = metrics_init(st.alive_prev.shape[0])
+    if flight is None:
+        flight = flight_init(st.alive_prev.shape[0])
+
+    def body(carry, t):
+        s, m, f = carry
+        s = tick(cfg, s, t)
+        f = flight_update(cfg, f, s, m, t)
+        m = metrics_update(m, s, cfg.log_cap)
+        return (s, m, f), None
+
+    (st, metrics, flight), _ = jax.lax.scan(
+        body, (st, metrics, flight), t0 + jnp.arange(n_ticks, dtype=I32))
+    return st, metrics, flight
+
+
+def flight_rows(f: Flight, g: int | None = None) -> list[dict]:
+    """Reduce the per-group rings over groups into one dict per recorded
+    tick, oldest first. `g` slices off kernel pad groups."""
+    leaves = {k: np.asarray(v) for k, v in zip(Flight._fields, f)}
+    if g is not None:
+        leaves = {k: v[:, :g] for k, v in leaves.items()}
+    ticks = leaves["tick"].max(axis=1)   # same value in every group lane
+    rows = []
+    for s in np.argsort(ticks, kind="stable"):
+        if ticks[s] < 0:
+            continue   # slot never written
+        rows.append({
+            "tick": int(ticks[s]),
+            "leaders": int(leaves["leaders"][s].astype(np.int64).sum()),
+            "elections": int(leaves["elections"][s].astype(np.int64).sum()),
+            "commit_total": int(leaves["commit"][s].astype(np.int64).sum()),
+            "msgs": int(leaves["msgs"][s].astype(np.int64).sum()),
+            "unsafe_groups": int((leaves["safety"][s] == 0).sum()),
+        })
+    return rows
+
+
+def dump_flight(f: Flight, g: int | None = None, label: str = "flight",
+                log=None) -> list[dict]:
+    """Print the ring, one line per recorded tick — called on any gate
+    failure so the last RING ticks of aggregate behavior land next to
+    the failure report. Returns the rows for callers that also want to
+    attach them to a manifest."""
+    if log is None:
+        def log(s):
+            print(s, file=sys.stderr, flush=True)
+    rows = flight_rows(f, g)
+    log(f"[{label}] flight recorder: {len(rows)} tick(s) recorded")
+    for r in rows:
+        log(f"[{label}]   tick {r['tick']:>6}: leaders={r['leaders']} "
+            f"elections={r['elections']} commit_total={r['commit_total']} "
+            f"msgs={r['msgs']} unsafe_groups={r['unsafe_groups']}")
+    return rows

@@ -1,0 +1,59 @@
+"""Divergence triage: bisect two engine trajectories to the first
+divergent tick, then name the first divergent leaf (DESIGN.md §8).
+
+A bare `state_identical: false` names neither WHERE nor WHEN the two
+engines parted. Triage exploits the property the whole repo is built
+on — an engine is a deterministic pure function of (state, n_ticks,
+t0), pinned by the checkpoint/resume tests — to re-execute cheaply:
+compare at chunk boundaries until the first unequal boundary, then
+re-run BOTH engines tick-by-tick from the last boundary where they were
+still byte-identical (one shared state, so re-execution is exact), and
+report the first tick whose post-states differ plus the first
+divergent leaf path (utils.trees.trees_equal_why).
+"""
+
+from __future__ import annotations
+
+from raft_tpu.utils.trees import trees_equal_why
+
+
+def bisect_divergence(engine_a, engine_b, st0, n_ticks: int, t0: int = 0,
+                      chunk: int = 16):
+    """First divergent (tick, leaf) between two engine trajectories.
+
+    `engine_x(st, n, t)` runs n ticks from absolute tick t and returns
+    the evolved state (e.g. ``lambda st, n, t: run(cfg, st, n, t)[0]``;
+    a pkernel wrapper works the same). Both engines start from `st0` at
+    `t0`. Returns None when every chunk boundary over [t0, t0+n_ticks)
+    is byte-identical, else::
+
+        {"tick": first tick t whose post-tick states differ,
+         "leaf_report": first divergent leaf path + dtype/shape + first
+                        differing element (trees_equal_why),
+         "boundary": the (start, end) chunk the bisection narrowed}
+
+    Cost: one pass at `chunk` granularity plus at most `chunk` single-
+    tick re-executions — two compiled programs per engine (n=chunk,
+    n=1), not one per tick.
+    """
+    sa = sb = st0
+    t, end = t0, t0 + n_ticks
+    while t < end:
+        n = min(chunk, end - t)
+        na = engine_a(sa, n, t)
+        nb = engine_b(sb, n, t)
+        ok, _ = trees_equal_why(na, nb)
+        if ok:
+            sa, sb, t = na, nb, t + n
+            continue
+        for dt in range(n):
+            sa = engine_a(sa, 1, t + dt)
+            sb = engine_b(sb, 1, t + dt)
+            ok, why = trees_equal_why(sa, sb)
+            if not ok:
+                return {"tick": t + dt, "leaf_report": why,
+                        "boundary": (t, t + n)}
+        raise AssertionError(
+            "chunk diverged but its tick-by-tick re-execution did not — "
+            "an engine is not a deterministic function of (state, t0)")
+    return None

@@ -97,6 +97,8 @@ class GlobalMetrics(NamedTuple):
     elections: jnp.ndarray   # i32 — completed leader acquisitions, psum
     hist: jnp.ndarray        # i32[H] — election-latency histogram, psum
     max_latency: jnp.ndarray  # i32 — longest completed streak, pmax
+    unsafe: jnp.ndarray      # i32 — groups whose per-tick safety bit
+    # dropped during the run (run.Metrics.safety), psum; 0 = clean soak
 
 
 def run_sharded(cfg: RaftConfig, st: State, n_ticks: int, mesh: Mesh,
@@ -120,6 +122,7 @@ def run_sharded(cfg: RaftConfig, st: State, n_ticks: int, mesh: Mesh,
             elections=jax.lax.psum(m.elections, AXIS),
             hist=jax.lax.psum(m.hist, AXIS),
             max_latency=jax.lax.pmax(m.max_latency, AXIS),
+            unsafe=jax.lax.psum(jnp.sum(1 - m.safety), AXIS),
         )
 
     f = _shard_map(local, mesh=mesh, in_specs=(P(AXIS),),

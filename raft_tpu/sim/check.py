@@ -1,10 +1,19 @@
 """Batched invariant checks over a `State` — the `Cluster` safety
 checkers (cluster.py:73-96) lifted to `[G, K]` arrays.
 
-Used by tests and `__graft_entry__.dryrun_multichip`; not part of the
-hot path. The differential suite is the strong correctness gate; these
-catch gross violations cheaply at 10^5-group scale where lockstep
-comparison is impractical.
+Used two ways (DESIGN.md §8):
+
+- Point-in-time: tests and `__graft_entry__.dryrun_multichip` call
+  `all_invariants` on an endpoint state — cheap gross-violation catch
+  at 10^5-group scale where lockstep comparison is impractical.
+- Per-tick: `tick_safety` is folded into `Metrics.safety` EVERY tick by
+  `run.metrics_update` (and its k-state port `pkernel._safety_tick`),
+  turning every bench run into a continuous runtime-verification soak —
+  a violation that exists for a single tick between check boundaries
+  can no longer hide.
+
+The differential suite remains the strong correctness gate; these
+predicates are the cheap always-on safety net.
 """
 
 from __future__ import annotations
@@ -56,3 +65,14 @@ def window_bounds(st: State, log_cap: int):
 def all_invariants(st: State, log_cap: int):
     return election_safety(st) & digest_agreement(st) & window_bounds(
         st, log_cap)
+
+
+def tick_safety(st: State, log_cap: int):
+    """bool[G]: the per-tick safety predicate ANDed into
+    `Metrics.safety` on both engines — election safety, digest
+    agreement, window bounds. A named alias of `all_invariants` so the
+    fold's contract ("what exactly does the safety bit attest?") has
+    one definition site; pkernel's `_safety_tick` must mirror any
+    change here term-for-term (pinned by the kernel differentials and
+    scripts/check_metric_parity.py's field parity)."""
+    return all_invariants(st, log_cap)

@@ -113,8 +113,17 @@ def load(path, cfg: Optional[RaftConfig] = None, sharding=None
         )
         metrics = None
         if "metrics.committed" in z.files:
-            metrics = Metrics(**{f: jnp.asarray(z[f"metrics.{f}"])
-                                 for f in Metrics._fields})
+            md = {f: jnp.asarray(z[f"metrics.{f}"])
+                  for f in Metrics._fields if f"metrics.{f}" in z.files}
+            if "safety" not in md:
+                # Pre-observability checkpoint: no per-tick safety bits
+                # were folded, so the resumed run's AND starts clean.
+                md["safety"] = jnp.ones_like(md["committed"])
+            missing = set(Metrics._fields) - set(md)
+            if missing:
+                raise KeyError(f"checkpoint missing metric field(s) "
+                               f"{sorted(missing)}")
+            metrics = Metrics(**md)
     if sharding is not None:
         st = jax.device_put(st, sharding)
     return st, t, metrics

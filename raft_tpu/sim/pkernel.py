@@ -25,6 +25,17 @@ remains the reference engine.
 `tests/test_pkernel.py` holds the two paths bit-identical on full State
 pytrees and metrics — histogram included — across fault mixes.
 
+Telemetry is folded IN-KERNEL, not scraped host-side (DESIGN.md §8):
+the per-tick safety bit (`_safety_tick`, the k-state port of
+`check.tick_safety`) ANDs into a per-group KMetrics lane every tick for
+a few vreg compares, and the optional flight-recorder ring
+(raft_tpu/obs/recorder.py) overwrites one row of six per-group
+[RING, 8, 128] accumulators per tick using the same one-hot-row pattern
+the histogram landed — a host readback of either would dominate the
+tick. Both are reduced/sliced host-side at kfinish/kflight and must be
+bit-identical to the XLA fold (run.metrics_update /
+obs.recorder.flight_update).
+
 `_on_ae_req` is the fused form of step.py's handler (DESIGN.md §7b):
 the four per-sender log-matching read passes (2E own-ring reads + 2E
 sender-ring pulls per message) collapse into ONE packed elementwise
@@ -90,6 +101,8 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_tpu.config import CONFIG_FLAG, RaftConfig
 from raft_tpu.core.node import (CANDIDATE, FOLLOWER, LEADER,
                                 NO_VOTE, PRECANDIDATE)
+from raft_tpu.obs.recorder import FLIGHT_LEAVES, PRESENCE_FIELDS, Flight
+from raft_tpu.obs.recorder import RING as FLIGHT_RING
 from raft_tpu.sim.run import HIST_SIZE, Metrics
 from raft_tpu.sim.state import BOOL, I32, Mailbox, PerNode, State
 from raft_tpu.utils import jrng
@@ -115,8 +128,13 @@ def kernel_vmem_bytes(cfg: RaftConfig) -> int:
         words += cfg.k * {"scalar": 1, "peer": cfg.k,
                           "ring": cfg.log_cap}[kind]
     words += len(_mb_fields(cfg)) * cfg.k * cfg.k
-    words += 2 + 4                       # alive_prev + group_id + metrics
-    block = words * 4 * GB + HIST_SIZE * 4 * SUB * LANE
+    # alive_prev + group_id + the per-group metric lanes (every metric
+    # leaf except the [H]-row hist, counted separately below).
+    words += 2 + (N_METRIC_LEAVES - 1)
+    # hist rows + the flight-recorder rows (reserved whether or not the
+    # caller passes a flight — the predicate must not flip per call).
+    block = (words * 4 * GB + HIST_SIZE * 4 * SUB * LANE
+             + len(FLIGHT_LEAVES) * FLIGHT_RING * 4 * SUB * LANE)
     return 5 * block
 
 
@@ -1082,36 +1100,109 @@ _TN_MB = ("tn_present", "tn_term")
 
 class KMetrics(NamedTuple):
     """Per-group metric tiles carried through the kernel ([8, 128] per
-    block; [GS, 128] in HBM). `elections` / `max_latency` are per-GROUP
-    here (run.Metrics keeps scalars) and `hist` is a per-group
-    [H, 8, 128] streak-length histogram ([H, GS, 128] in HBM) — each
-    group's lane accumulates its own bucket counts, updated by a
-    one-hot row add (Mosaic has no scatter), and kfinish reduces over
-    groups host-side. Integer adds reassociate exactly, so the reduced
-    histogram is bit-identical to the XLA path's global scatter-add."""
+    block; [GS, 128] in HBM). Field order IS the wire order
+    (METRIC_LEAVES; scripts/check_metric_parity.py pins the two).
+    `elections` / `max_latency` are per-GROUP here (run.Metrics keeps
+    scalars) and `hist` is a per-group [H, 8, 128] streak-length
+    histogram ([H, GS, 128] in HBM) — each group's lane accumulates its
+    own bucket counts, updated by a one-hot row add (Mosaic has no
+    scatter), and kfinish reduces over groups host-side. Integer adds
+    reassociate exactly, so the reduced histogram is bit-identical to
+    the XLA path's global scatter-add. `safety` is the per-group
+    per-tick safety AND (run.Metrics.safety) — a pass-through lane:
+    kinit loads the caller's bits, the kernel ANDs into them, kfinish
+    reads them back."""
     committed: jnp.ndarray
     leaderless: jnp.ndarray
     elections: jnp.ndarray
     max_latency: jnp.ndarray
+    safety: jnp.ndarray
     hist: jnp.ndarray
 
 
-def _metrics_tick(m: KMetrics, nodes, alive_now) -> KMetrics:
-    """run.metrics_update against k-state values, histogram included."""
+def _safety_tick(cfg, nodes):
+    """check.tick_safety on k-state tiles, one [8, 128] bit per group:
+    election safety (pairwise leader term compare), digest agreement on
+    equal applied prefixes, per-node window bounds — term-for-term the
+    predicates in sim/check.py, statically unrolled over K (and K^2/2
+    pairs) like every other kernel reduction."""
+    ok = None
+    for j in range(cfg.k):
+        wb = ((nodes.applied[j] == nodes.commit[j])
+              & (nodes.snap_index[j] <= nodes.commit[j])
+              & (nodes.commit[j] <= nodes.last_index[j])
+              & (nodes.last_index[j] - nodes.snap_index[j] <= cfg.log_cap))
+        ok = wb if ok is None else ok & wb
+    for a in range(cfg.k):
+        for b in range(a + 1, cfg.k):
+            clash = ((nodes.role[a] == LEADER) & (nodes.role[b] == LEADER)
+                     & (nodes.term[a] == nodes.term[b]))
+            split = ((nodes.applied[a] == nodes.applied[b])
+                     & (nodes.digest[a] != nodes.digest[b]))
+            ok = ok & ~clash & ~split
+    return ok
+
+
+def _presence_fields(cfg):
+    """The mailbox occupancy fields present under `cfg`, in the shared
+    obs.recorder.PRESENCE_FIELDS order (None-skipping on the XLA side,
+    static gating here — same surviving list)."""
+    skip = set()
+    if not cfg.prevote:
+        skip.update(("pv_req_present", "pv_resp_present"))
+    if not cfg.transfer_u32:
+        skip.add("tn_present")
+    return [f for f in PRESENCE_FIELDS if f not in skip]
+
+
+def _metrics_tick(cfg, m: KMetrics, fl, nodes, mailbox, alive_now, t):
+    """run.metrics_update + obs.recorder.flight_update against k-state
+    values — histogram, safety bit, and (when `fl` is not None) the
+    flight-recorder ring. `mailbox` is the post-tick outbox (presence
+    already widened to i32); `t` the absolute tick."""
     committed = jnp.maximum(m.committed, jnp.max(nodes.commit, axis=0))
     has_leader = jnp.any((nodes.role == LEADER) & alive_now, axis=0)
     done = has_leader & (m.leaderless > 0)
+    safe = _safety_tick(cfg, nodes)
     hsize = m.hist.shape[0]
     bucket = jnp.minimum(m.leaderless, hsize - 1)
     hrow = jax.lax.broadcasted_iota(I32, (hsize, 1, 1), 0)
-    return KMetrics(
+    met = KMetrics(
         committed=committed,
         leaderless=jnp.where(has_leader, 0, m.leaderless + 1),
         elections=m.elections + done.astype(I32),
         max_latency=jnp.maximum(m.max_latency,
                                 jnp.where(done, m.leaderless, 0)),
+        safety=jnp.where(safe, m.safety, 0),
         hist=m.hist + ((hrow == bucket) & done).astype(I32),
     )
+    if fl is None:
+        return met, None
+    # Flight ring: overwrite row t % RING of each per-group ring with
+    # this tick's aggregates (obs/recorder.py flight_update, k-state
+    # flavor; the one-hot row select is the histogram's pattern).
+    on = _col(fl.tick.shape[0]) == (t % fl.tick.shape[0])
+    leaders = None
+    for j in range(cfg.k):
+        v = ((nodes.role[j] == LEADER) & alive_now[j]).astype(I32)
+        leaders = v if leaders is None else leaders + v
+    commit_max = nodes.commit[0]
+    for j in range(1, cfg.k):
+        commit_max = jnp.maximum(commit_max, nodes.commit[j])
+    msgs = None
+    for f in _presence_fields(cfg):
+        p = getattr(mailbox, f)   # i32 [K, K, 8, 128] post-tick
+        v = jnp.sum(jnp.sum(p, axis=0), axis=0)
+        msgs = v if msgs is None else msgs + v
+
+    def w(r, val):
+        return jnp.where(on, val, r)
+
+    fl = Flight(tick=w(fl.tick, t), leaders=w(fl.leaders, leaders),
+                elections=w(fl.elections, done.astype(I32)),
+                commit=w(fl.commit, commit_max), msgs=w(fl.msgs, msgs),
+                safety=w(fl.safety, safe.astype(I32)))
+    return met, fl
 
 
 def _node_leaves(cfg):
@@ -1197,11 +1288,14 @@ def _from_kstate(cfg, flat, g: int) -> State:
                  alive_prev=alive, group_id=gid)
 
 
-def _build_kernel(cfg, n_ticks):
-    """The pallas kernel body: load block -> fori_loop of ticks -> store."""
+def _build_kernel(cfg, n_ticks, with_flight):
+    """The pallas kernel body: load block -> fori_loop of ticks -> store.
+    `with_flight` (static) adds the six flight-recorder ring leaves
+    between the group ids and the metric tail (wire order)."""
     node_kinds = _node_leaves(cfg)
     mb_fields = _mb_fields(cfg)
     n_in = (len(node_kinds) + len(mb_fields) + 2    # + alive, gid
+            + (len(FLIGHT_LEAVES) if with_flight else 0)
             + N_METRIC_LEAVES)
 
     def kernel(t0_ref, *refs):
@@ -1226,16 +1320,18 @@ def _build_kernel(cfg, n_ticks):
             md[f] = a
         alive_prev = next(it)[:] != 0
         g = next(it)[:]
-        met = KMetrics(committed=next(it)[:], leaderless=next(it)[:],
-                       elections=next(it)[:], max_latency=next(it)[:],
-                       hist=next(it)[:])
+        fl = None
+        if with_flight:
+            fl = Flight(**{f: next(it)[:] for f in FLIGHT_LEAVES})
+        met = KMetrics(**{f: next(it)[:] for f in METRIC_LEAVES})
         nodes = PerNode(**nd)
         mailbox = Mailbox(**md)
         t0 = t0_ref[0]
 
         # The loop carry is i32-only: Mosaic fails to legalize scf.for
         # with i1 vector block arguments, so bool leaves cross the loop
-        # boundary widened and are re-derived each iteration.
+        # boundary widened and are re-derived each iteration. (KMetrics
+        # and Flight leaves are i32 by construction — safety included.)
         def widen(tree):
             return jax.tree.map(
                 lambda a: a.astype(I32) if a.dtype == jnp.bool_ else a, tree)
@@ -1248,15 +1344,17 @@ def _build_kernel(cfg, n_ticks):
         proto = (nodes, mailbox, alive_prev)
 
         def body(tt, carry):
-            state_i, met = carry
+            state_i, met, fl = carry
             nodes, mailbox, alive_prev = narrow_like(state_i, proto)
             nodes, mailbox, alive_now = _tick(cfg, nodes, mailbox,
                                               alive_prev, g, t0 + tt)
-            met = _metrics_tick(met, nodes, alive_now)
-            return widen((nodes, mailbox, alive_now)), met
+            met, fl = _metrics_tick(cfg, met, fl, nodes, mailbox,
+                                    alive_now, t0 + tt)
+            return widen((nodes, mailbox, alive_now)), met, fl
 
-        state_i, met = jax.lax.fori_loop(
-            0, n_ticks, body, (widen((nodes, mailbox, alive_prev)), met))
+        state_i, met, fl = jax.lax.fori_loop(
+            0, n_ticks, body,
+            (widen((nodes, mailbox, alive_prev)), met, fl))
         nodes, mailbox, alive_prev = narrow_like(state_i, proto)
 
         ot = iter(out_refs)
@@ -1270,11 +1368,11 @@ def _build_kernel(cfg, n_ticks):
                 if a.dtype in (jnp.bool_, jnp.uint32) else a
         next(ot)[:] = alive_prev.astype(I32)
         next(ot)[:] = g
-        next(ot)[:] = met.committed
-        next(ot)[:] = met.leaderless
-        next(ot)[:] = met.elections
-        next(ot)[:] = met.max_latency
-        next(ot)[:] = met.hist
+        if with_flight:
+            for f in FLIGHT_LEAVES:
+                next(ot)[:] = getattr(fl, f)
+        for f in METRIC_LEAVES:
+            next(ot)[:] = getattr(met, f)
 
     return kernel
 
@@ -1292,7 +1390,8 @@ def _gspec(a):
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_ticks", "interpret"))
 def _prun_padded(cfg, leaves, t0, n_ticks, interpret=False):
-    kernel = _build_kernel(cfg, n_ticks)
+    with_flight = len(leaves) > _n_state_leaves(cfg) + N_METRIC_LEAVES
+    kernel = _build_kernel(cfg, n_ticks, with_flight)
     nb = leaves[0].shape[-2] // SUB
     in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
     in_specs += [_gspec(a) for a in leaves]
@@ -1311,14 +1410,19 @@ def _prun_padded(cfg, leaves, t0, n_ticks, interpret=False):
     )(t0a, *leaves)
 
 
-def kinit(cfg: RaftConfig, st: State, metrics: Metrics | None = None):
-    """Convert (State, Metrics) to the kernel wire form ONCE. Returns
-    (leaves, g): `leaves` is the flat tuple `kstep` launches on, `g`
-    the unpadded group count. The conversion transposes the whole
-    state; at 100K groups it costs more than a 200-tick kernel launch,
-    so chunked drivers must call kinit/kfinish once around the chunk
-    loop, never per chunk (that mistake hid the kernel's speed behind
-    2s/chunk of host-side reshuffling when first measured)."""
+def kinit(cfg: RaftConfig, st: State, metrics: Metrics | None = None,
+          flight: Flight | None = None):
+    """Convert (State, Metrics[, Flight]) to the kernel wire form ONCE.
+    Returns (leaves, g): `leaves` is the flat tuple `kstep` launches on,
+    `g` the unpadded group count. Passing a `flight`
+    (obs.recorder.flight_init) turns on the in-kernel flight-recorder
+    ring — its six leaves ride the wire between the group ids and the
+    metric tail, and `kflight` reads them back. The conversion
+    transposes the whole state; at 100K groups it costs more than a
+    200-tick kernel launch, so chunked drivers must call kinit/kfinish
+    once around the chunk loop, never per chunk (that mistake hid the
+    kernel's speed behind 2s/chunk of host-side reshuffling when first
+    measured)."""
     from raft_tpu.sim.run import metrics_init
     g = st.alive_prev.shape[0]
     if metrics is None:
@@ -1335,17 +1439,30 @@ def kinit(cfg: RaftConfig, st: State, metrics: Metrics | None = None):
             [st.group_id, jnp.arange(g, g + pad, dtype=I32)]))
         mc = jnp.pad(metrics.committed, (0, pad))
         ml = jnp.pad(metrics.leaderless, (0, pad))
+        ms = jnp.pad(metrics.safety, (0, pad), constant_values=1)
     else:
-        stp, mc, ml = st, metrics.committed, metrics.leaderless
+        stp, mc, ml, ms = (st, metrics.committed, metrics.leaderless,
+                           metrics.safety)
     leaves = _to_kstate(cfg, stp)
-    # elections / max_latency / hist accumulate from zero in-kernel;
+    fleaves = []
+    if flight is not None:
+        for name in FLIGHT_LEAVES:
+            a = getattr(flight, name)
+            if pad:
+                a = jnp.pad(a, ((0, 0), (0, pad)),
+                            constant_values=-1 if name == "tick" else 0)
+            fleaves.append(_fold_g(a))
+    # elections / max_latency / hist accumulate from zero in-kernel and
     # kfinish folds the caller's metrics_base back in (scalars add,
-    # histograms add bucket-wise), so nothing of `metrics` is lost.
+    # histograms add bucket-wise); committed / leaderless / safety are
+    # pass-through lanes the kernel continues in place. Nothing of
+    # `metrics` is lost either way. Order: METRIC_LEAVES.
     mleaves = [_fold_g(mc), _fold_g(ml),
                _fold_g(jnp.zeros(g + pad, I32)),
                _fold_g(jnp.zeros(g + pad, I32)),
+               _fold_g(ms),
                _fold_g(jnp.zeros((metrics.hist.shape[0], g + pad), I32))]
-    return tuple(leaves + mleaves), g
+    return tuple(leaves + fleaves + mleaves), g
 
 
 def kstep(cfg: RaftConfig, leaves, t0: int, n_ticks: int,
@@ -1358,14 +1475,22 @@ def kstep(cfg: RaftConfig, leaves, t0: int, n_ticks: int,
 
 
 METRIC_LEAVES = ("committed", "leaderless", "elections", "max_latency",
-                 "hist")   # wire order of the metric tail; hist LAST
+                 "safety", "hist")   # wire order of the metric tail;
+#                  == KMetrics._fields (parity-checked); hist LAST
 N_METRIC_LEAVES = len(METRIC_LEAVES)
+
+
+def _n_state_leaves(cfg) -> int:
+    """Wire leaves ahead of the (flight, metrics) tail: node + mailbox
+    leaves + alive_prev + group_id."""
+    return len(_node_leaves(cfg)) + len(_mb_fields(cfg)) + 2
 
 
 def _mleaf(leaves, name: str):
     """The named metric leaf of a wire tuple — indexed by METRIC_LEAVES
-    position, so appending a future leaf cannot silently shift the
-    counters the bench reads (kcommitted/kelections/khist)."""
+    position from the END (the metric tail is last whether or not
+    flight leaves ride the wire), so adding a leaf cannot silently
+    shift the counters the bench reads (kcommitted/kelections/khist)."""
     return leaves[METRIC_LEAVES.index(name) - N_METRIC_LEAVES]
 
 
@@ -1403,40 +1528,71 @@ def khist(leaves, g: int):
     return mh.sum(axis=1, dtype=np.int32)
 
 
+def kflight(cfg: RaftConfig, leaves, g: int) -> Flight | None:
+    """Host-side Flight from the wire form ([RING, g] per leaf, pad
+    groups sliced off), or None when kinit ran without a flight."""
+    n_state = _n_state_leaves(cfg)
+    n_flight = len(leaves) - n_state - N_METRIC_LEAVES
+    if n_flight == 0:
+        return None
+    if n_flight != len(FLIGHT_LEAVES):
+        # ValueError, not assert (stripped under python -O): a wrong
+        # count means mis-assigned leaves, which must fail loudly, not
+        # feed garbage into the flight_identical gate.
+        raise ValueError(
+            f"wire tuple has {n_flight} leaves between the state and "
+            f"metric tails; expected 0 or {len(FLIGHT_LEAVES)} (a Flight)")
+    return Flight(*[jnp.asarray(_unfold_g(a))[:, :g]
+                    for a in leaves[n_state:n_state + n_flight]])
+
+
 def kfinish(cfg: RaftConfig, leaves, g: int,
             metrics_base: Metrics | None = None):
     """Wire form -> (State, Metrics). `metrics_base` supplies prior
     elections/max_latency scalars and histogram counts to fold in —
-    continuation semantics identical to passing `metrics` to run.run.
-    The histogram is REAL: per-group in-kernel accumulators reduced
-    over groups (bit-identical to the XLA scatter-add)."""
+    continuation semantics identical to passing `metrics` to run.run
+    (committed / leaderless / safety were continued in place on the
+    wire, like the state itself). The histogram is REAL: per-group
+    in-kernel accumulators reduced over groups (bit-identical to the
+    XLA scatter-add). Flight leaves, when present, are skipped here —
+    read them with `kflight`."""
     from raft_tpu.sim.run import metrics_init
     if metrics_base is None:
         metrics_base = metrics_init(g)
-    n_state = len(leaves) - N_METRIC_LEAVES
+    n_state = _n_state_leaves(cfg)
     st = _from_kstate(cfg, [_unfold_g(a) for a in leaves[:n_state]], g)
-    mc, ml, me, mx = [_unfold_g(_mleaf(leaves, n))[:g]
-                      for n in METRIC_LEAVES[:4]]
+    mc, ml, me, mx, ms = [
+        _unfold_g(_mleaf(leaves, n))[:g]
+        for n in ("committed", "leaderless", "elections", "max_latency",
+                  "safety")]
     met = Metrics(
         committed=mc, leaderless=ml,
         elections=metrics_base.elections + jnp.sum(me),
         hist=metrics_base.hist + khist(leaves, g),
         max_latency=jnp.maximum(metrics_base.max_latency, jnp.max(mx)),
+        safety=ms,
     )
     return st, met
 
 
 def prun(cfg: RaftConfig, st: State, n_ticks: int, t0: int = 0,
-         metrics: Metrics | None = None, interpret: bool = False):
+         metrics: Metrics | None = None, interpret: bool = False,
+         flight: Flight | None = None):
     """Drop-in for `sim.run.run` on supported configs: same (State,
-    Metrics) out, same bits — latency histogram included. One launch +
-    both conversions — for chunked loops use kinit/kstep/kfinish
-    directly. Raises ValueError on unsupported shapes (supported())."""
+    Metrics) out, same bits — latency histogram and safety bit
+    included. Passing `flight` mirrors `obs.recorder.run_recorded`:
+    the in-kernel ring rides along and a (State, Metrics, Flight)
+    triple comes back. One launch + both conversions — for chunked
+    loops use kinit/kstep/kfinish directly. Raises ValueError on
+    unsupported shapes (supported())."""
     if not supported(cfg):
         raise ValueError(
             "pkernel: shape unsupported (k > 30 or VMEM footprint "
             f"{kernel_vmem_bytes(cfg)} B > {VMEM_LIMIT_BYTES} B) — "
             "use the XLA path (run.run)")
-    leaves, g = kinit(cfg, st, metrics)
+    leaves, g = kinit(cfg, st, metrics, flight)
     leaves = kstep(cfg, leaves, t0, n_ticks, interpret=interpret)
-    return kfinish(cfg, leaves, g, metrics)
+    if flight is None:
+        return kfinish(cfg, leaves, g, metrics)
+    st2, met = kfinish(cfg, leaves, g, metrics)
+    return st2, met, kflight(cfg, leaves, g)

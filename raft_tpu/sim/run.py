@@ -18,12 +18,23 @@ Metrics:
   q-quantile hit the absorbing bucket. p50/p99 are computed host-side
   from the histogram (`latency_quantile`).
 
+- `safety[G]` (DESIGN.md §8): a per-group running AND of the per-tick
+  safety predicate `check.tick_safety` (election safety, digest
+  agreement, window bounds). 1 = every tick of the run satisfied every
+  invariant; 0 = at least one tick violated at least one — so a
+  violation that exists for a single tick between check boundaries
+  (two leaders in the same term that never coexist at an endpoint)
+  still latches. Folded in-kernel on the Pallas path for the same
+  reason the histogram is: a host readback would dominate the tick,
+  a handful of vreg compares does not.
+
 Both engines fold the same metrics every tick: this scanned path
 scatter-adds into the global histogram directly; the Pallas fused-chunk
 kernel (sim/pkernel.py) accumulates per-group histogram lanes in-kernel
 and reduces them over groups at kfinish — bit-identical, since i32 adds
 reassociate exactly (held by tests/test_pkernel.py and bench.py's
-in-run fault-segment differentials).
+in-run fault-segment differentials). The per-tick flight-recorder ring
+rides the same fold via `raft_tpu.obs.recorder.run_recorded`.
 """
 
 from __future__ import annotations
@@ -37,6 +48,7 @@ import numpy as np
 
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.node import LEADER
+from raft_tpu.sim import check
 from raft_tpu.sim.state import I32, State
 from raft_tpu.sim.step import tick
 
@@ -49,6 +61,7 @@ class Metrics(NamedTuple):
     elections: jnp.ndarray   # i32 — completed leader-acquisition events
     hist: jnp.ndarray        # i32[H] — election-latency histogram
     max_latency: jnp.ndarray  # i32 — exact longest completed streak
+    safety: jnp.ndarray      # i32[G] — per-tick safety AND (1 = never bad)
 
 
 def metrics_init(n_groups: int, hist_size: int = HIST_SIZE) -> Metrics:
@@ -58,11 +71,13 @@ def metrics_init(n_groups: int, hist_size: int = HIST_SIZE) -> Metrics:
         elections=jnp.zeros((), I32),
         hist=jnp.zeros(hist_size, I32),
         max_latency=jnp.zeros((), I32),
+        safety=jnp.ones(n_groups, I32),
     )
 
 
-def metrics_update(m: Metrics, st: State) -> Metrics:
-    """Fold one post-tick state into the metrics."""
+def metrics_update(m: Metrics, st: State, log_cap: int) -> Metrics:
+    """Fold one post-tick state into the metrics. `log_cap` bounds the
+    window check inside the per-tick safety fold (check.tick_safety)."""
     nodes = st.nodes
     committed = jnp.maximum(m.committed, jnp.max(nodes.commit, axis=1))
     has_leader = jnp.any((nodes.role == LEADER) & st.alive_prev, axis=1)
@@ -76,6 +91,7 @@ def metrics_update(m: Metrics, st: State) -> Metrics:
         hist=m.hist.at[bucket].add(done.astype(I32)),
         max_latency=jnp.maximum(
             m.max_latency, jnp.max(jnp.where(done, m.leaderless, 0))),
+        safety=jnp.where(check.tick_safety(st, log_cap), m.safety, 0),
     )
 
 
@@ -93,7 +109,7 @@ def run(cfg: RaftConfig, st: State, n_ticks: int, t0=0,
     def body(carry, t):
         s, m = carry
         s = tick(cfg, s, t)
-        return (s, metrics_update(m, s)), None
+        return (s, metrics_update(m, s, cfg.log_cap)), None
 
     (st, metrics), _ = jax.lax.scan(
         body, (st, metrics), t0 + jnp.arange(n_ticks, dtype=I32))
@@ -137,6 +153,13 @@ def latency_quantile(hist, q: float) -> int:
         return 0
     cum = np.cumsum(h)
     return int(np.searchsorted(cum, q * total, side="left"))
+
+
+def unsafe_groups(metrics: Metrics) -> int:
+    """Host-side count of groups whose per-tick safety bit dropped at
+    any point in the run (0 = the whole run was a clean soak). Benches,
+    the dryrun, and the kernel sweep print this next to every number."""
+    return int((np.asarray(metrics.safety) == 0).sum())
 
 
 def latency_censored(hist, q: float) -> bool:

@@ -15,6 +15,13 @@ contract's guarantee of at most one message per (type, src, dst) per tick
 (DESIGN.md §2). `Mailbox` triples as the in-flight buffer (`[G, K, K]`
 leading dims), a node's inbox (`[K_src]` after transpose + vmap), and a
 node's outbox (`[K_dst]` inside the per-node step).
+
+The observability layer (DESIGN.md §8) treats this State as its whole
+read surface: the per-tick safety fold (sim/check.py `tick_safety`) and
+the flight recorder's message-volume signal (obs/recorder.py, summing
+the `*_present` occupancy bits below) are pure functions of a post-tick
+State — adding a leaf here extends the triage/diff surface
+automatically (utils/trees names leaves by pytree path).
 """
 
 from __future__ import annotations

@@ -17,6 +17,14 @@ Faults (DESIGN.md §4) are applied at the batch level: the delivery
 filter masks mailbox occupancy bits, crash masks freeze dead nodes'
 state wholesale and erase their outbox, and the dead->alive edge applies
 `Node.restart()` semantics (durable survives, volatile rewinds).
+
+Observability note (DESIGN.md §8): `tick` itself carries NO telemetry —
+it stays the minimal reference program both engines are pinned to. The
+per-tick safety fold and flight-recorder capture read the POST-tick
+state from outside: `run.metrics_update` / `obs.recorder.flight_update`
+here, `pkernel._metrics_tick` in-kernel. Changing tick semantics
+changes what those folds attest; keep check.tick_safety's invariants
+true at every tick boundary.
 """
 
 from __future__ import annotations

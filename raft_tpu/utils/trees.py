@@ -1,7 +1,9 @@
 """Byte-identical pytree comparison — the ONE definition behind every
-engine-differential gate (test suite, bench promotion, kernel sweep).
-Semantic changes here (dtype sensitivity, NaN handling) propagate to
-all gates at once instead of drifting between hand-rolled copies."""
+engine-differential gate (test suite, bench promotion, kernel sweep) —
+plus the leaf-level divergence report that feeds triage
+(raft_tpu/obs/triage.py). Semantic changes here (dtype sensitivity, NaN
+handling) propagate to all gates at once instead of drifting between
+hand-rolled copies."""
 
 from __future__ import annotations
 
@@ -11,20 +13,56 @@ import numpy as np
 
 def trees_equal(a, b) -> bool:
     """True iff the two pytrees have the same leaf count and every leaf
-    pair is byte-identical (np.array_equal)."""
+    pair is byte-identical (np.array_equal semantics: NaN != NaN)."""
     ok, _ = trees_equal_why(a, b)
     return ok
 
 
+def leaf_mismatch(x, y) -> str | None:
+    """None when the two arrays are byte-identical; otherwise a one-line
+    description carrying dtype, shape, the differing-element count, and
+    the first differing index with both values — enough to aim a triage
+    bisection without re-running anything."""
+    x, y = np.asarray(x), np.asarray(y)
+    meta_x = f"{x.dtype}{list(x.shape)}"
+    meta_y = f"{y.dtype}{list(y.shape)}"
+    if x.shape != y.shape:
+        return f"shape mismatch: {meta_x} vs {meta_y}"
+    if x.dtype != y.dtype:
+        return f"dtype mismatch: {meta_x} vs {meta_y}"
+    neq = x != y   # NaN != NaN — matches np.array_equal's default
+    n_bad = int(np.count_nonzero(neq))
+    if n_bad == 0:
+        return None
+    if neq.ndim == 0:
+        return f"{meta_x}: {x!r} != {y!r}"
+    first = np.unravel_index(int(np.argmax(neq)), neq.shape)
+    idx = ",".join(str(int(i)) for i in first)
+    return (f"{meta_x}: {n_bad}/{x.size} elements differ, first at "
+            f"[{idx}]: {x[first]!r} != {y[first]!r}")
+
+
+def _label(path, n, names):
+    if names and n < len(names):
+        return names[n]
+    label = jax.tree_util.keystr(path) if path else ""
+    return label or f"leaf {n}"
+
+
 def trees_equal_why(a, b, names=None):
-    """(equal, why) — like `trees_equal`, but `why` names the first
-    divergent leaf (via `names`, e.g. a NamedTuple's `_fields`) or the
-    leaf-count mismatch, for diagnostics."""
-    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
-    if len(la) != len(lb):
-        return False, f"leaf count {len(la)} != {len(lb)}"
-    for n, (x, y) in enumerate(zip(la, lb)):
-        if not np.array_equal(np.asarray(x), np.asarray(y)):
-            label = names[n] if names and n < len(names) else f"leaf {n}"
-            return False, f"first divergent leaf: {label}"
+    """(equal, why) — like `trees_equal`, but `why` names the FIRST
+    divergent leaf by its pytree path (e.g. `.nodes.log_term` for a
+    `State`) with its dtype/shape and first differing element, or the
+    leaf-count mismatch. `names` (e.g. a NamedTuple's `_fields`)
+    overrides the path labels when given — kept for callers that compare
+    bare leaf tuples with their own naming."""
+    pa, _ = jax.tree_util.tree_flatten_with_path(a)
+    pb, _ = jax.tree_util.tree_flatten_with_path(b)
+    if len(pa) != len(pb):
+        return False, f"leaf count {len(pa)} != {len(pb)}"
+    for n, ((path_x, x), (_, y)) in enumerate(zip(pa, pb)):
+        why = leaf_mismatch(x, y)
+        if why is not None:
+            return False, (f"first divergent leaf: "
+                           f"{_label(path_x, n, names)} — {why}")
     return True, ""

@@ -1,0 +1,83 @@
+"""Static metric-surface parity check: the XLA path's `Metrics`, the
+kernel's `KMetrics`, its wire order `METRIC_LEAVES`, and the flight
+recorder's `Flight`/`FLIGHT_LEAVES` must stay name-, dtype-, order-,
+and shape-aligned — the bench promotion gates and kfinish's name-based
+wire indexing all assume it. Exits nonzero on any drift; runs in tier-1
+via tests/test_obs.py (fast: builds two host-side pytrees, no jit).
+
+    python scripts/check_metric_parity.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # runnable as `python scripts/...`
+
+# Static check — never let the import initialize a real accelerator.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def check() -> list[str]:
+    """Returns the list of parity problems (empty = aligned)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from raft_tpu.obs.recorder import FLIGHT_LEAVES, RING, Flight, flight_init
+    from raft_tpu.sim.pkernel import KMetrics, METRIC_LEAVES, N_METRIC_LEAVES
+    from raft_tpu.sim.run import HIST_SIZE, Metrics, metrics_init
+
+    problems = []
+    if KMetrics._fields != METRIC_LEAVES:
+        problems.append(f"KMetrics fields {KMetrics._fields} != wire order "
+                        f"METRIC_LEAVES {METRIC_LEAVES}")
+    if set(Metrics._fields) != set(METRIC_LEAVES):
+        problems.append(f"Metrics fields {sorted(Metrics._fields)} != "
+                        f"METRIC_LEAVES names {sorted(METRIC_LEAVES)}")
+    if N_METRIC_LEAVES != len(METRIC_LEAVES):
+        problems.append("N_METRIC_LEAVES out of sync with METRIC_LEAVES")
+    if Flight._fields != FLIGHT_LEAVES:
+        problems.append(f"Flight fields {Flight._fields} != wire order "
+                        f"FLIGHT_LEAVES {FLIGHT_LEAVES}")
+
+    g = 4
+    m = metrics_init(g)
+    # The kernel wire is i32 lanes: every metric leaf must be i32, with
+    # the shapes kinit folds ([G] per-group, scalar, or [H] histogram).
+    want_shape = {"committed": (g,), "leaderless": (g,), "elections": (),
+                  "hist": (HIST_SIZE,), "max_latency": (), "safety": (g,)}
+    for name in Metrics._fields:
+        leaf = getattr(m, name)
+        if leaf.dtype != jnp.int32:
+            problems.append(f"Metrics.{name} dtype {leaf.dtype} != int32 "
+                            f"(kernel wire lanes are i32)")
+        if leaf.shape != want_shape[name]:
+            problems.append(f"Metrics.{name} shape {leaf.shape} != "
+                            f"{want_shape[name]}")
+    f = flight_init(g)
+    for name in Flight._fields:
+        leaf = getattr(f, name)
+        if leaf.dtype != jnp.int32:
+            problems.append(f"Flight.{name} dtype {leaf.dtype} != int32")
+        if leaf.shape != (RING, g):
+            problems.append(f"Flight.{name} shape {leaf.shape} != "
+                            f"{(RING, g)}")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        for p in problems:
+            print(f"METRIC PARITY DRIFT: {p}")
+        return 1
+    print("metric parity ok: Metrics == KMetrics == METRIC_LEAVES; "
+          "Flight == FLIGHT_LEAVES; all leaves i32 at wire shapes")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,7 +39,7 @@ import jax
 from raft_tpu import sim
 from raft_tpu.config import RaftConfig
 from raft_tpu.sim import pkernel
-from raft_tpu.sim.run import metrics_init, run
+from raft_tpu.sim.run import metrics_init, run, unsafe_groups
 from raft_tpu.utils.trees import trees_equal_why
 
 # Factor order: (prevote, reconfig, transfer, reads, partition).
@@ -87,7 +87,10 @@ def sweep_configs(base_seed: int):
 
 def run_universe(cfg: RaftConfig, n_groups: int, ticks: int,
                  interpret: bool):
-    """(ok, detail, seconds) for one universe's kernel-vs-XLA check."""
+    """(ok, detail, seconds, unsafe) for one universe's kernel-vs-XLA
+    check. `unsafe` counts groups whose per-tick safety bit dropped —
+    each universe doubles as an n_groups x ticks safety soak, so the
+    sweep log is soak evidence, not just divergence evidence."""
     t0 = time.perf_counter()
     st0 = sim.init(cfg, n_groups=n_groups)
     stx, mx = run(cfg, st0, ticks, 0, metrics_init(n_groups))
@@ -95,10 +98,13 @@ def run_universe(cfg: RaftConfig, n_groups: int, ticks: int,
     s_ok, s_why = trees_equal_why(stx, stp)
     m_ok, m_why = trees_equal_why(
         mx, mp, names=list(type(mx)._fields))
+    unsafe = unsafe_groups(mx)
     dt = time.perf_counter() - t0
     if s_ok and m_ok:
-        return True, "bit-identical (state + metrics incl. histogram)", dt
-    return False, f"state: {s_why or 'ok'}; metrics: {m_why or 'ok'}", dt
+        return (True, "bit-identical (state + metrics incl. histogram "
+                "+ safety bit)", dt, unsafe)
+    return (False, f"state: {s_why or 'ok'}; metrics: {m_why or 'ok'}",
+            dt, unsafe)
 
 
 def main():
@@ -121,7 +127,7 @@ def main():
               "--groups/--ticks) for a CPU smoke", file=sys.stderr)
         return 2
 
-    failures = 0
+    failures = violations = swept = 0
     for n, cfg in enumerate(sweep_configs(args.seed)):
         feats = "+".join(f for f, on in zip(FACTORS, ROWS[n]) if on) \
             or "faults-only"
@@ -129,16 +135,24 @@ def main():
             print(f"[{n}] k={cfg.k} L={cfg.log_cap} {feats}: UNSUPPORTED "
                   f"shape (skipped)", flush=True)
             continue
-        ok, detail, dt = run_universe(cfg, args.groups, args.ticks,
-                                      args.interpret)
+        ok, detail, dt, unsafe = run_universe(cfg, args.groups, args.ticks,
+                                              args.interpret)
         tag = "ok" if ok else "DIVERGED"
+        safe_tag = "ok" if unsafe == 0 else f"VIOLATED({unsafe} groups)"
         print(f"[{n}] seed={cfg.seed} k={cfg.k} L={cfg.log_cap} "
-              f"{feats}: {tag} — {detail} ({dt:.1f}s)", flush=True)
+              f"{feats}: {tag} safety={safe_tag} — {detail} ({dt:.1f}s)",
+              flush=True)
         failures += 0 if ok else 1
-    if failures:
-        print(f"{failures} universe(s) DIVERGED", file=sys.stderr)
+        violations += 0 if unsafe == 0 else 1
+        swept += 1
+    if failures or violations:
+        print(f"{failures} universe(s) DIVERGED, {violations} with safety "
+              f"violations", file=sys.stderr)
         return 1
-    print("sweep clean: every universe bit-identical", file=sys.stderr)
+    print(f"sweep clean: every universe bit-identical; per-tick safety "
+          f"bit held across all {swept} universes "
+          f"({args.groups} groups x {args.ticks} ticks each)",
+          file=sys.stderr)
     return 0
 
 

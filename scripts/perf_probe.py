@@ -51,7 +51,7 @@ def make_runner(cfg, with_metrics: str):
             s, mm = carry
             s = _raw_tick(cfg, s, t)
             if with_metrics == "full":
-                mm = metrics_update(mm, s)
+                mm = metrics_update(mm, s, cfg.log_cap)
             elif with_metrics == "nohist":
                 nodes = s.nodes
                 committed = jnp.maximum(mm.committed,

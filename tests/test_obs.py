@@ -1,0 +1,187 @@
+"""Observability-layer tests (DESIGN.md §8): the comparator names the
+first divergent leaf, triage bisects a synthetic corruption to the
+exact tick and leaf, the flight recorder rides the scanned runner and
+is chunk-invariant, the per-tick safety fold latches real violations,
+metric parity between the engines is pinned statically, and manifests
+round-trip.
+
+Kernel-engine counterparts (safety-bit and flight-ring bit-parity
+against the XLA path) live in tests/test_pkernel.py with the other
+interpret-mode differentials."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from conftest import trees_equal as _trees_equal
+from raft_tpu import sim
+from raft_tpu.config import RaftConfig
+from raft_tpu.obs import (RING, bisect_divergence, config_hash,
+                          emit_manifest, flight_init, flight_rows,
+                          run_recorded)
+from raft_tpu.sim.run import metrics_init, metrics_update, run, unsafe_groups
+from raft_tpu.utils.trees import trees_equal_why
+
+CFG = RaftConfig(n_groups=8, k=3, seed=21, drop_prob=0.05, crash_prob=0.2,
+                 crash_epoch=16, log_cap=8, compact_every=4)
+
+
+def test_trees_reports_first_divergent_leaf_path():
+    """The comparator names the leaf PATH, dtype/shape, and the first
+    differing element — no more bare boolean False in gate output."""
+    st = sim.init(CFG)
+    bad = st._replace(nodes=st.nodes._replace(
+        deadline=st.nodes.deadline.at[2, 1].add(5)))
+    ok, why = trees_equal_why(st, bad)
+    assert not ok
+    assert "deadline" in why
+    assert "int32" in why
+    assert "[2,1]" in why
+    assert "1/24 elements differ" in why
+    ok, why = trees_equal_why(st, st)
+    assert ok and why == ""
+
+
+def test_triage_bisects_to_corrupted_tick_and_leaf():
+    """Synthetic corruption: one state leaf flipped mid-run. Triage must
+    name the exact first divergent tick and the corrupted leaf."""
+    corrupt_at, n_ticks = 21, 32
+
+    def clean(st, n, t):
+        return run(CFG, st, n, t)[0]
+
+    def corrupt(st, n, t0):
+        # Deterministic in (state, t0): re-execution through the
+        # corrupted tick reproduces the same corruption — the property
+        # bisect_divergence's tick-by-tick stage relies on.
+        for t in range(t0, t0 + n):
+            st = run(CFG, st, 1, t)[0]
+            if t == corrupt_at:
+                st = st._replace(nodes=st.nodes._replace(
+                    term=st.nodes.term.at[3, 1].add(7)))
+        return st
+
+    report = bisect_divergence(clean, corrupt, sim.init(CFG), n_ticks,
+                               chunk=16)
+    assert report is not None
+    assert report["tick"] == corrupt_at
+    assert report["boundary"] == (16, 32)
+    assert "term" in report["leaf_report"]
+    assert "[3,1]" in report["leaf_report"]
+    # And a clean pair reports no divergence.
+    assert bisect_divergence(clean, clean, sim.init(CFG), n_ticks,
+                             chunk=16) is None
+
+
+def test_triage_names_kernel_wire_leaf():
+    """A flipped kernel wire leaf surfaces under its State field name
+    after kfinish — the kernel-state flavor of leaf naming (no kernel
+    launch: kinit/kfinish round-trip only)."""
+    from raft_tpu.sim import pkernel
+    from raft_tpu.sim.state import PerNode
+
+    st0 = sim.init(CFG)
+    leaves, g = pkernel.kinit(CFG, st0)
+    idx = PerNode._fields.index("voted_for")
+    bad = list(leaves)
+    bad[idx] = bad[idx].at[0, 0, 0].add(1)
+    stc, _ = pkernel.kfinish(CFG, tuple(bad), g)
+    ok, why = trees_equal_why(st0, stc)
+    assert not ok
+    assert "voted_for" in why
+
+
+def test_flight_recorder_rides_the_scan():
+    """run_recorded == run bit-for-bit on state+metrics, the ring holds
+    one row per tick (n_ticks < RING), and the rows cross-check the
+    metrics fold."""
+    st0 = sim.init(CFG)
+    st_ref, m_ref = run(CFG, st0, 40)
+    st, m, f = run_recorded(CFG, st0, 40)
+    assert _trees_equal(st_ref, st)
+    assert _trees_equal(m_ref, m)
+    rows = flight_rows(f)
+    assert [r["tick"] for r in rows] == list(range(40))
+    assert sum(r["elections"] for r in rows) == int(m.elections)
+    assert all(r["unsafe_groups"] == 0 for r in rows)
+    assert all(0 <= r["leaders"] <= CFG.n_groups * CFG.k for r in rows)
+    # Chunk boundaries are invisible to the recording.
+    st2, m2, f2 = run_recorded(CFG, st0, 24)
+    st2, m2, f2 = run_recorded(CFG, st2, 16, 24, m2, f2)
+    assert _trees_equal(f, f2)
+
+
+def test_flight_ring_wraps():
+    """Past RING ticks the ring keeps exactly the last RING ticks."""
+    n_ticks = RING + 16
+    _, _, f = run_recorded(CFG, sim.init(CFG), n_ticks)
+    rows = flight_rows(f)
+    assert [r["tick"] for r in rows] == list(range(16, n_ticks))
+
+
+def test_safety_bit_latches_violations():
+    """The per-tick fold stays 1 through a legitimately faulted run and
+    latches 0 on a synthetic invariant violation (window bound)."""
+    st, m = run(CFG, sim.init(CFG), 48)
+    assert unsafe_groups(m) == 0
+    assert m.safety.shape == (CFG.n_groups,)
+    bad = st._replace(nodes=st.nodes._replace(
+        commit=st.nodes.commit + 1000))   # commit > last_index everywhere
+    m2 = metrics_update(m, bad, CFG.log_cap)
+    assert unsafe_groups(m2) == CFG.n_groups
+    # The AND latches: a later clean tick cannot clear it.
+    m3 = metrics_update(m2, st, CFG.log_cap)
+    assert unsafe_groups(m3) == CFG.n_groups
+
+
+def test_metric_parity_script():
+    """The static Metrics/KMetrics/Flight parity gate runs clean —
+    tier-1 coverage for scripts/check_metric_parity.py."""
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "check_metric_parity.py")
+    proc = subprocess.run([sys.executable, script], capture_output=True,
+                          text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "metric parity ok" in proc.stdout
+
+
+def test_manifest_roundtrip(tmp_path):
+    path = tmp_path / "manifest.jsonl"
+    rec = emit_manifest("unit-test", CFG, device="cpu:test",
+                        path=str(path), rate=123.4, safety_ok=True)
+    lines = path.read_text().splitlines()
+    assert len(lines) == 1
+    loaded = json.loads(lines[0])
+    assert loaded == json.loads(json.dumps(rec))
+    assert loaded["segment"] == "unit-test"
+    assert loaded["config_hash"] == config_hash(CFG)
+    assert loaded["config"]["seed"] == CFG.seed
+    assert loaded["jax"] and loaded["device"] == "cpu:test"
+    assert loaded["rate"] == 123.4 and loaded["safety_ok"] is True
+    # Appending and hash sensitivity.
+    emit_manifest("unit-test-2", RaftConfig(seed=99), device="cpu:test",
+                  path=str(path))
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2
+    assert json.loads(lines[1])["config_hash"] != config_hash(CFG)
+
+
+def test_checkpointed_metrics_carry_safety(tmp_path):
+    """Metrics.safety survives a save/load round trip, and a resumed
+    run continues the same AND chain."""
+    from raft_tpu.sim import checkpoint
+
+    st, m = run(CFG, sim.init(CFG), 24)
+    path = tmp_path / "ckpt.npz"
+    checkpoint.save(path, st, 24, m, cfg=CFG)
+    st2, t2, m2 = checkpoint.load(path, cfg=CFG)
+    assert _trees_equal(m, m2)
+    a, ma = run(CFG, st, 24, 24, m)
+    b, mb = run(CFG, st2, 24, t2, m2)
+    assert _trees_equal(a, b)
+    assert np.array_equal(np.asarray(ma.safety), np.asarray(mb.safety))

@@ -33,6 +33,8 @@ def test_sharded_run_matches_unsharded():
     assert int(gm.rounds) == int(np.asarray(ref_m.committed).sum())
     assert int(gm.elections) == int(ref_m.elections)
     assert np.array_equal(np.asarray(gm.hist), np.asarray(ref_m.hist))
+    # The psum'd per-tick safety verdict equals the local fold's.
+    assert int(gm.unsafe) == int((np.asarray(ref_m.safety) == 0).sum()) == 0
     assert bool(np.all(np.asarray(check.all_invariants(st, cfg.log_cap))))
 
 

@@ -43,7 +43,12 @@ def _diff(cfg, n_ticks, chunks=None):
     # lets the fault benches (p50/p99) ride the kernel engine.
     assert np.array_equal(np.asarray(mx.hist), np.asarray(mp.hist)), \
         "election-latency histogram diverged from the XLA path"
-    return stp
+    # The in-kernel per-tick safety fold must agree bit-for-bit with the
+    # XLA fold (DESIGN.md §8) — every kernel differential doubles as a
+    # safety-telemetry parity check.
+    assert np.array_equal(np.asarray(mx.safety), np.asarray(mp.safety)), \
+        "per-tick safety bit diverged from the XLA path"
+    return stp, mp
 
 
 @pytest.mark.slow
@@ -87,7 +92,7 @@ def test_feature_mix_bit_exact():
                      transfer_prob=0.7, transfer_epoch=24,
                      read_every=4, crash_prob=0.15, crash_epoch=24,
                      drop_prob=0.04, log_cap=8, compact_every=4)
-    stp = _diff(cfg, 64)
+    stp, _ = _diff(cfg, 64)
     full = (1 << cfg.k) - 1
     assert ((np.asarray(stp.nodes.snap_voters) != full).any()
             or (np.asarray(stp.nodes.log_payload) & CONFIG_FLAG).any()), \
@@ -101,7 +106,7 @@ def test_scheduled_reads_bit_exact():
     drops forcing retries."""
     cfg = RaftConfig(n_groups=12, k=3, seed=13, read_every=4,
                      drop_prob=0.05, log_cap=8, compact_every=4)
-    stp = _diff(cfg, 48)
+    stp, _ = _diff(cfg, 48)
     assert int(np.asarray(stp.nodes.reads_done).sum()) > 0
 
 
@@ -124,7 +129,7 @@ def test_fused_ae_smoke():
     cfg = RaftConfig(n_groups=8, k=3, seed=40, crash_prob=0.5,
                      crash_epoch=8, drop_prob=0.05,
                      log_cap=8, compact_every=4)
-    stp = _diff(cfg, 32)
+    stp, _ = _diff(cfg, 32)
     assert int(np.asarray(stp.nodes.term).max()) > 1, \
         "no leadership churn - fused conflict/backup coverage is vacuous"
     assert int(np.asarray(stp.nodes.commit).max()) > 0, \
@@ -178,7 +183,10 @@ def test_engine_hop_via_checkpoint(tmp_path):
 
 
 def test_kstate_round_trip():
-    """kinit -> kfinish with zero ticks is the identity on State."""
+    """kinit -> kfinish with zero ticks is the identity on State (and
+    on the Flight ring when one rides the wire)."""
+    from raft_tpu.obs import flight_init
+
     cfg = RaftConfig(n_groups=10, k=4, seed=3)
     st0 = state.init(cfg)
     leaves, g = pkernel.kinit(cfg, st0)
@@ -186,3 +194,46 @@ def test_kstate_round_trip():
     assert trees_equal(st0, st1)
     assert pkernel.kcommitted(leaves, g) == 0
     assert pkernel.kelections(leaves, g) == 0
+    assert pkernel.kflight(cfg, leaves, g) is None
+    fleaves, g = pkernel.kinit(cfg, st0, flight=flight_init(10))
+    st2, _ = pkernel.kfinish(cfg, fleaves, g)
+    assert trees_equal(st0, st2)
+    assert trees_equal(pkernel.kflight(cfg, fleaves, g), flight_init(10))
+
+
+def test_safety_bit_parity_faulted_64_groups():
+    """The per-tick safety fold, XLA vs Pallas on a faulted 64-group
+    schedule (crash + partition + drop): the two engines' safety bits
+    must be bit-identical (asserted inside _diff), every group must
+    have folded a real tick history (elections happened), and the run
+    must be clean — 64 groups x 48 ticks x 3 nodes of soak."""
+    from raft_tpu.sim.run import unsafe_groups
+
+    cfg = RaftConfig(n_groups=64, k=3, seed=23, drop_prob=0.05,
+                     crash_prob=0.2, crash_epoch=16,
+                     partition_prob=0.2, partition_epoch=16,
+                     log_cap=8, compact_every=4)
+    stp, mp = _diff(cfg, 48)
+    assert int(mp.elections) > 0, "no elections - safety soak is vacuous"
+    assert unsafe_groups(mp) == 0
+    assert mp.safety.shape == (64,)
+
+
+def test_flight_ring_parity_in_kernel():
+    """The in-kernel flight-recorder ring (six per-group [RING, 8, 128]
+    accumulator leaves) must be bit-identical to the XLA recorder's
+    [RING, G] rings at the same tick, crash churn included."""
+    from raft_tpu.obs import flight_init, run_recorded
+
+    cfg = RaftConfig(n_groups=8, k=3, seed=40, crash_prob=0.5,
+                     crash_epoch=8, drop_prob=0.05,
+                     log_cap=8, compact_every=4)
+    st0 = state.init(cfg)
+    stx, mx, fx = run_recorded(cfg, st0, 32)
+    stp, mp, fp = pkernel.prun(cfg, st0, 32, interpret=True,
+                               flight=flight_init(8))
+    assert trees_equal(stx, stp)
+    assert trees_equal(mx, mp)
+    assert trees_equal(fx, fp), "flight ring diverged from the XLA path"
+    assert int(np.asarray(fp.elections).sum()) == int(mp.elections), \
+        "ring elections do not cross-check the metrics fold"
