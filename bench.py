@@ -20,6 +20,13 @@ carries `state_identical` in the JSON. The config-2 shape — pure
 leader-election rounds, no client commands — reports elections/sec at
 10K groups under constant crash churn. Per-phase detail goes to stderr.
 
+Multi-chip (DESIGN.md §9): when more than one TPU chip is visible, the
+kernel segments run the SAME fused-chunk kernel shard_map'd over the
+group mesh (raft_tpu/parallel/kmesh.py) — per-device grids, no
+collectives inside the timed region — and the engine string says so
+(`pallas-fused-chunk-sharded-8dev`); every manifest records the mesh
+shape and per-device group count. The XLA reference stays single-device.
+
 Observability (DESIGN.md §8): both engines fold the per-tick safety bit
 (every segment is a groups x ticks x k node-tick soak; `safety_ok` per
 segment and globally in the JSON), both carry the on-device flight
@@ -65,12 +72,70 @@ def _device_str() -> str:
     return f"{dev.platform}:{dev.device_kind}"
 
 
-def _gate_fields(label: str, pal, m_ref, f_ref, n_groups: int) -> dict:
-    """The verdict/wall fields every steady-state segment shares
-    (throughput / election-rounds / reads): the per-tick safety verdict
-    plus the kernel promotion verdicts and its compile-wall — assembled
-    once so the three segment dicts cannot drift apart."""
+def _kernel_mesh():
+    """The kernel data-parallel mesh: every visible TPU chip, or None
+    when one (or zero) chips are visible — the unsharded kstep path.
+    The XLA reference engine stays single-device either way; only the
+    kernel segments ride the mesh (DESIGN.md §9)."""
+    devs = jax.devices()
+    if devs[0].platform == "tpu" and len(devs) > 1:
+        from raft_tpu import parallel
+        return parallel.make_mesh(len(devs))
+    return None
+
+
+def _kernel_engine(cfg, n_groups: int):
+    """(nd, name, kinit, kstep): the ONE kernel harness both kernel
+    drivers (_pallas_segment, bench_fault_latency) share — sharded
+    over every visible TPU chip, or the single-device kstep path. The
+    engine NAME constructed here is load-bearing: _gate_fields and the
+    fault segment decide mesh provenance by comparing the promoted
+    engine string against it, so it must have exactly one producer."""
+    from raft_tpu.sim import pkernel
+    mesh = _kernel_mesh()
+    nd = mesh.size if mesh is not None else 1
+    name = ("pallas-fused-chunk" if mesh is None
+            else f"pallas-fused-chunk-sharded-{nd}dev")
+    if mesh is not None:
+        from raft_tpu.parallel import kmesh
+
+        def kinit(st_in):
+            return kmesh.kinit_sharded(cfg, st_in, mesh,
+                                       flight=flight_init(n_groups))
+
+        def kstep(lvs, at, n):
+            return kmesh.kstep_sharded(cfg, lvs, at, n, mesh)
+    else:
+        def kinit(st_in):
+            return pkernel.kinit(cfg, st_in, flight=flight_init(n_groups))
+
+        def kstep(lvs, at, n):
+            return pkernel.kstep(cfg, lvs, at, n)
+    return nd, name, kinit, kstep
+
+
+def _mesh_fields(n_groups: int, nd: int) -> dict:
+    """Provenance for every manifest record: the device-mesh shape the
+    segment's PROMOTED engine actually ran on — a segment that fell
+    back to the single-device XLA scan (kernel unsupported, mismatch,
+    or error) must say mesh_shape=[1] or a reader would divide its
+    rate across chips that never ran it. Callers pass the device count
+    as a VALUE (the kernel harness knows it), never re-derived from a
+    display string."""
+    return {"mesh_shape": [nd], "groups_per_device": -(-n_groups // nd)}
+
+
+def _gate_fields(label: str, pal, m_ref, f_ref, n_groups: int,
+                 engine: str) -> dict:
+    """The verdict/wall/mesh-provenance fields every steady-state
+    segment shares (throughput / election-rounds / reads): the per-tick
+    safety verdict, the kernel promotion verdicts and compile-wall, and
+    the mesh fields for the engine that actually stood (`engine` equals
+    the kernel's own name only when it was promoted; any fallback means
+    the single-device XLA scan ran) — assembled once so the three
+    segment dicts cannot drift apart."""
     unsafe = _safety_check(label, m_ref, f_ref, n_groups)
+    nd_eff = pal["nd"] if engine == pal["engine"] else 1
     return {
         "state_identical": pal["state_identical"],
         "metrics_identical": pal["metrics_identical"],
@@ -79,6 +144,7 @@ def _gate_fields(label: str, pal, m_ref, f_ref, n_groups: int) -> dict:
                                  if pal["warmup_s"] is not None else None),
         "safety_ok": unsafe == 0,
         "unsafe_groups": unsafe,
+        **_mesh_fields(n_groups, nd_eff),
     }
 
 
@@ -180,19 +246,24 @@ def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
     """
     fail = dict(rate=None, count=None, elapsed=None, warmup_s=None,
                 state_identical=None, metrics_identical=None,
-                flight_identical=None)
+                flight_identical=None, engine="pallas-fused-chunk", nd=1)
     try:   # kernel failure of ANY kind (incl. import) never kills the bench
         from raft_tpu.sim import pkernel
-        if not (pkernel.supported(cfg)
+        # Sharded engine when >1 chip is visible (DESIGN.md §9): same
+        # kernel, per-device grids over device-local blocks, zero
+        # collectives per launch — conversion + placement happen in
+        # kinit, outside the timed region.
+        nd, name, kinit, kstep = _kernel_engine(cfg, n_groups)
+        fail["engine"], fail["nd"] = name, nd
+        if not (pkernel.supported(cfg, n_groups, nd)
                 and jax.devices()[0].platform == "tpu"):
             return {**fail, "status": "unsupported"}
         counter_fn = getattr(pkernel, counter_name)
-        leaves, g = pkernel.kinit(cfg, sim.init(cfg, n_groups=n_groups),
-                                  flight=flight_init(n_groups))
+        leaves, g = kinit(sim.init(cfg, n_groups=n_groups))
         t0 = time.perf_counter()
-        leaves = pkernel.kstep(cfg, leaves, 0, CHUNK)
+        leaves = kstep(leaves, 0, CHUNK)
         counter_fn(leaves, g)                            # forces compile #1
-        leaves = pkernel.kstep(cfg, leaves, CHUNK, CHUNK)
+        leaves = kstep(leaves, CHUNK, CHUNK)
         base = counter_fn(leaves, g)                     # forces compile #2
         warmup_s = time.perf_counter() - t0
         log(f"  [pallas] warmup {2 * CHUNK} ticks (incl. 2 compiles): "
@@ -200,11 +271,12 @@ def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
         n_chunks = timed_ticks // CHUNK
         start = time.perf_counter()
         for c in range(n_chunks):
-            leaves = pkernel.kstep(cfg, leaves, (c + 2) * CHUNK, CHUNK)
+            leaves = kstep(leaves, (c + 2) * CHUNK, CHUNK)
         count = counter_fn(leaves, g) - base    # fetch closes the timer
         elapsed = time.perf_counter() - start
         rate = count / elapsed
-        log(f"  [pallas] {n_groups} groups x {timed_ticks} ticks: "
+        log(f"  [pallas{'' if nd == 1 else f' x{nd}dev'}] "
+            f"{n_groups} groups x {timed_ticks} ticks: "
             f"{count} {what} in {elapsed:.2f}s -> {rate:,.0f} {what}/s "
             f"({elapsed / timed_ticks * 1e3:.2f} ms/tick)")
         st_ref, m_ref, f_ref = run_recorded(cfg, st_ref, CHUNK,
@@ -222,7 +294,8 @@ def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
             log("  [pallas] differential vs xla at same tick: full State "
                 "+ full Metrics + flight ring bit-identical")
             return dict(rate=rate, count=count, elapsed=elapsed,
-                        warmup_s=warmup_s, status="ok", **verdicts)
+                        warmup_s=warmup_s, status="ok", engine=name,
+                        nd=nd, **verdicts)
         log(f"  [pallas] DIFFERENTIAL MISMATCH (state_identical={state_ok} "
             f"metrics_identical={metrics_ok} flight_identical={flight_ok})"
             f" - kernel number discarded")
@@ -265,7 +338,7 @@ def bench_throughput(n_groups: int, ticks: int):
                           st_ref, m_ref, f_ref, "rounds")
     if pal["status"] == "ok" and pal["rate"] > rps:
         rps, rounds, elapsed = pal["rate"], pal["count"], pal["elapsed"]
-        engine = "pallas-fused-chunk"
+        engine = pal["engine"]
     elif pal["status"] == "mismatch":
         engine = "xla-scan (pallas mismatch!)"
     ok = pal["status"] == "ok"
@@ -277,7 +350,8 @@ def bench_throughput(n_groups: int, ticks: int):
         "pallas_rounds_per_sec": round(pal["rate"], 1) if ok else None,
         "pallas_ms_per_tick": (round(pal["elapsed"] / timed_ticks * 1e3, 3)
                                if ok else None),
-        **_gate_fields("throughput", pal, m_ref, f_ref, n_groups),
+        **_gate_fields("throughput", pal, m_ref, f_ref, n_groups,
+                       engine),
     }
     emit_manifest("throughput", cfg, device=_device_str(),
                   n_groups=n_groups, **seg)
@@ -327,27 +401,30 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
     engine, k_elapsed, k_warmup_s = "xla-scan", None, None
     state_ok = metrics_ok = flight_ok = None
     elapsed = x_elapsed
+    # Defaults survive an exception before the mesh probe assigns them:
+    # the manifest's mesh fields must be computable on EVERY path.
+    nd, k_name = 1, "pallas-fused-chunk"
     try:   # kernel failure of ANY kind never kills the bench
         from raft_tpu.sim import pkernel
-        if pkernel.supported(cfg) and jax.devices()[0].platform == "tpu":
+        nd, k_name, kinit, kstep = _kernel_engine(cfg, n_groups)
+        if pkernel.supported(cfg, n_groups, nd) \
+                and jax.devices()[0].platform == "tpu":
             # Warmup on a throwaway universe: compile #1 (kinit
             # layouts) + compile #2 (kernel-chained layouts).
             t0 = time.perf_counter()
-            wl, wg = pkernel.kinit(cfg, sim.init(cfg, n_groups=n_groups),
-                                   flight=flight_init(n_groups))
-            wl = pkernel.kstep(cfg, wl, 0, CHUNK)
+            wl, wg = kinit(sim.init(cfg, n_groups=n_groups))
+            wl = kstep(wl, 0, CHUNK)
             pkernel.kelections(wl, wg)
-            wl = pkernel.kstep(cfg, wl, CHUNK, CHUNK)
+            wl = kstep(wl, CHUNK, CHUNK)
             pkernel.kelections(wl, wg)
             k_warmup_s = time.perf_counter() - t0
             log(f"  [pallas] warmup (incl. 2 compiles): {k_warmup_s:.1f}s")
-            leaves, g = pkernel.kinit(cfg, sim.init(cfg, n_groups=n_groups),
-                                      flight=flight_init(n_groups))
+            leaves, g = kinit(sim.init(cfg, n_groups=n_groups))
             start = time.perf_counter()
             at = 0
             while at < ticks:
                 n = min(CHUNK, ticks - at)
-                leaves = pkernel.kstep(cfg, leaves, at, n)
+                leaves = kstep(leaves, at, n)
                 at += n
             pkernel.kelections(leaves, g)   # fetch closes the timer
             k_elapsed = time.perf_counter() - start
@@ -356,13 +433,14 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
             state_ok, s_why = _trees_equal_why(st, st_pal)
             metrics_ok, m_why = _trees_equal_why(m, m_pal)
             flight_ok, f_why = _trees_equal_why(f, f_pal)
-            log(f"  [pallas] {label} {n_groups} groups x {ticks} ticks in "
+            log(f"  [pallas{'' if nd == 1 else f' x{nd}dev'}] {label} "
+                f"{n_groups} groups x {ticks} ticks in "
                 f"{k_elapsed:.2f}s ({k_elapsed / ticks * 1e3:.2f} ms/tick)")
             if state_ok and metrics_ok and flight_ok:
                 log("  [pallas] differential vs xla at same tick: full "
                     "State + full Metrics (incl. histogram + safety) + "
                     "flight ring bit-identical")
-                engine, elapsed = "pallas-fused-chunk", k_elapsed
+                engine, elapsed = k_name, k_elapsed
             else:
                 log(f"  [pallas] DIFFERENTIAL MISMATCH (state_identical="
                     f"{state_ok} metrics_identical={metrics_ok} "
@@ -406,9 +484,13 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
         "kernel_warmup_wall_s": (round(k_warmup_s, 3)
                                  if k_warmup_s is not None else None),
         "safety_ok": unsafe == 0, "unsafe_groups": unsafe,
+        # Mesh provenance in the segment dict itself (not only the
+        # manifest), matching the _gate_fields segments — the BENCH
+        # JSON's fault entries must say their engine's device count too.
+        **_mesh_fields(n_groups, nd if engine == k_name else 1),
     }
-    emit_manifest(label, cfg, device=_device_str(), **{
-        k: v for k, v in seg.items() if k != "p99_note"})
+    emit_manifest(label, cfg, device=_device_str(),
+                  **{k: v for k, v in seg.items() if k != "p99_note"})
     return seg
 
 
@@ -442,7 +524,7 @@ def bench_election_rounds(n_groups: int, ticks: int):
                           st_ref, m_ref, f_ref, "elections")
     if pal["status"] == "ok" and pal["rate"] > eps:
         eps, elections = pal["rate"], pal["count"]
-        engine = "pallas-fused-chunk"
+        engine = pal["engine"]
     elif pal["status"] == "mismatch":
         engine = "xla-scan (pallas mismatch!)"
     seg = {
@@ -450,7 +532,8 @@ def bench_election_rounds(n_groups: int, ticks: int):
         "engine": engine,
         "timed_wall_s": round(elapsed, 3),
         "xla_warmup_wall_s": round(warmup_s, 3),
-        **_gate_fields("election-rounds", pal, m_ref, f_ref, n_groups),
+        **_gate_fields("election-rounds", pal, m_ref, f_ref, n_groups,
+                       engine),
     }
     emit_manifest("election-rounds", cfg, device=_device_str(),
                   n_groups=n_groups, ticks=timed_ticks, **seg)
@@ -481,14 +564,14 @@ def bench_reads(n_groups: int, ticks: int):
                           st_ref, m_ref, f_ref, "reads")
     if pal["status"] == "ok" and pal["rate"] > rps:
         rps, reads = pal["rate"], pal["count"]
-        engine = "pallas-fused-chunk"
+        engine = pal["engine"]
     elif pal["status"] == "mismatch":
         engine = "xla-scan (pallas mismatch!)"
     seg = {
         "reads_per_sec": round(rps, 1), "reads": reads, "engine": engine,
         "timed_wall_s": round(elapsed, 3),
         "xla_warmup_wall_s": round(warmup_s, 3),
-        **_gate_fields("reads", pal, m_ref, f_ref, n_groups),
+        **_gate_fields("reads", pal, m_ref, f_ref, n_groups, engine),
     }
     emit_manifest("reads", cfg, device=_device_str(), n_groups=n_groups,
                   ticks=timed_ticks, **seg)

@@ -12,6 +12,13 @@ Schema (one JSON object per line; `schema` bumps on breaking change):
     config        the full RaftConfig dict the hash covers
     jax, jaxlib   library versions
     device        "platform:device_kind" of jax.devices()[0]
+    mesh_shape    device-mesh shape the segment's engine ran on, e.g.
+                  [8] for an 8-way group-sharded run; [1] single-chip;
+                  null when the caller did not say (DESIGN.md §9 — a
+                  rounds/s number without its device count is not a
+                  per-chip claim)
+    groups_per_device
+                  G / mesh size (ceil), same null rule
     ...           caller fields: engine, warmup_wall_s / timed_wall_s
                   (the compile-vs-run split), rates, state_identical /
                   metrics_identical / flight_identical verdicts,
@@ -66,7 +73,11 @@ def emit_manifest(segment: str, cfg, device: str | None = None,
            "unix_time": round(time.time(), 3),
            "config_hash": config_hash(cfg),
            "config": dataclasses.asdict(cfg),
-           "jax": jv, "jaxlib": jlv, "device": device}
+           "jax": jv, "jaxlib": jlv, "device": device,
+           # Mesh provenance keys exist in EVERY record (null until the
+           # caller fills them) so a reader can always distinguish "ran
+           # on one chip" from "device count unrecorded".
+           "mesh_shape": None, "groups_per_device": None}
     rec.update(fields)
     path = path or os.environ.get(MANIFEST_ENV) or DEFAULT_PATH
     if path != "-":

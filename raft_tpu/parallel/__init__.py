@@ -1,4 +1,5 @@
-"""Device-mesh sharding of the groups axis (DESIGN.md §5, config 5)."""
+"""Device-mesh sharding of the groups axis (DESIGN.md §5, config 5;
+§9 for the kernel wire form — raft_tpu.parallel.kmesh)."""
 
 from raft_tpu.parallel.mesh import (AXIS, make_mesh, run_sharded,
                                     shard_state, state_sharding)

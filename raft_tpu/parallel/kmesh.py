@@ -1,0 +1,192 @@
+"""Data-parallel driver for the Pallas fused-chunk kernel: the wire
+form sharded over the 1-D group mesh (DESIGN.md §9).
+
+Raft groups never talk to each other, so multi-chip for the kernel is
+the same story `mesh.run_sharded` tells for the XLA path: shard the
+groups axis, run the UNCHANGED single-chip program per device, reduce
+metrics at the boundary. Here the shard is of the kernel's wire form —
+every leaf carries the folded group axis at dim -2 ([..., GS, LANE]),
+so one PartitionSpec rule (`kleaf_spec`) shards all of them — and the
+per-device program is the same `pallas_call` grid `kstep` launches,
+over the device's own blocks. A chunk launch is communication-free:
+no collective appears anywhere inside `kstep_sharded`, so ticks/s
+scales with devices until per-chip HBM, not ICI, is the wall.
+
+Layout contract: `kinit(..., pad_to=n_devices * GB)` pads the group
+axis so each device holds whole 1024-group blocks; pad groups carry
+global group ids past `g` (their seed streams are junk but harmless —
+groups are independent and `kfinish` slices them off) and their metric
+lanes are masked by group id in `kglobal_sharded`'s psum. State
+correctness under sharding rides on `State.group_id` traveling with the
+shard, exactly like the XLA path (sim/state.py).
+
+The psum'd boundary (`kglobal_sharded`) exists for drivers that want
+global verdict counters without gathering per-group arrays — the
+dryrun and the multichip sweep. Differential gates keep using
+`kfinish`/`kflight` on the (global, sharded) leaves: outside the
+shard_map those are ordinary global arrays, so the full-pytree
+comparators work unchanged, and `tests/test_kmesh.py` pins the
+8-way-sharded kernel bit-identical to the unsharded kernel and the
+XLA path on a faulted universe.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.obs.recorder import Flight
+from raft_tpu.parallel.mesh import AXIS, _shard_map
+from raft_tpu.sim import pkernel
+from raft_tpu.sim.run import Metrics
+from raft_tpu.sim.state import I32, State
+
+
+def faulted_64_cfg() -> RaftConfig:
+    """THE shared sharded-differential universe: 64 faulted k=3/L=8
+    groups (crash + partition + drop). tests/test_kmesh.py, the
+    dryrun's `dryrun_pallas_mesh` segment, and multichip_sweep's
+    CPU dryrun cells + interpret gate all simulate exactly this config
+    so ONE interpret-mode kernel compile (minutes on the CPU box)
+    serves every driver — defined once here so a drift in any driver
+    cannot silently turn the others back into cold compiles."""
+    return RaftConfig(n_groups=64, k=3, seed=23, drop_prob=0.05,
+                      crash_prob=0.2, crash_epoch=16,
+                      partition_prob=0.2, partition_epoch=16,
+                      log_cap=8, compact_every=4)
+
+
+def kleaf_spec(a) -> P:
+    """PartitionSpec sharding a wire leaf's folded GS axis (dim -2 of
+    every leaf — [K, GS, 128], [K, L, GS, 128], [H, GS, 128], ...)."""
+    return P(*([None] * (a.ndim - 2) + [AXIS, None]))
+
+
+def shard_kleaves(leaves, mesh: Mesh):
+    """Place a wire tuple onto `mesh`, GS axis sharded. The leaves must
+    have come from `kinit(..., pad_to=mesh.size * GB)` so each device
+    shard is a whole number of kernel blocks."""
+    return tuple(jax.device_put(a, NamedSharding(mesh, kleaf_spec(a)))
+                 for a in leaves)
+
+
+def kinit_sharded(cfg: RaftConfig, st: State, mesh: Mesh,
+                  metrics: Metrics | None = None,
+                  flight: Flight | None = None):
+    """`pkernel.kinit` padded for and placed onto `mesh`. Same
+    (leaves, g) contract; call once around a chunk loop."""
+    leaves, g = pkernel.kinit(cfg, st, metrics, flight,
+                              pad_to=mesh.size * pkernel.GB)
+    return shard_kleaves(leaves, mesh), g
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "n_ticks", "mesh", "interpret"))
+def _kstep_sharded(cfg, mesh, t0, leaves, n_ticks, interpret):
+    specs = tuple(kleaf_spec(a) for a in leaves)
+
+    def local(t0s, *lvs):
+        return pkernel._prun_padded(cfg, tuple(lvs), t0s, n_ticks,
+                                    interpret=interpret)
+
+    f = _shard_map(local, mesh=mesh, in_specs=(P(),) + specs,
+                   out_specs=specs)
+    return f(t0, *leaves)
+
+
+def kstep_sharded(cfg: RaftConfig, leaves, t0: int, n_ticks: int,
+                  mesh: Mesh, interpret: bool = False):
+    """`pkernel.kstep` with the launch shard_map'd over `mesh`: each
+    device runs the kernel grid over its own blocks, no collectives.
+    `t0` stays traced, so chunked calls at advancing t0 reuse ONE
+    compiled sharded program — the property the bench's timed region
+    depends on."""
+    return tuple(_kstep_sharded(cfg, mesh, jnp.asarray(int(t0), I32),
+                                tuple(leaves), int(n_ticks),
+                                bool(interpret)))
+
+
+class GlobalKMetrics(NamedTuple):
+    """Mesh-reduced verdict counters off the kernel wire — the sharded
+    kernel's analogue of mesh.GlobalMetrics. i32 on-device (x64 is
+    off); for promoted throughput numbers use the int64 host-side
+    counters (`pkernel.kcommitted`) instead."""
+    rounds: jnp.ndarray      # i32 — committed entries, psum over mesh
+    elections: jnp.ndarray   # i32 — completed elections, psum
+    hist: jnp.ndarray        # i32[H] — election-latency histogram, psum
+    max_latency: jnp.ndarray  # i32 — longest completed streak, pmax
+    unsafe: jnp.ndarray      # i32 — groups whose per-tick safety bit
+    # dropped (psum); 0 = the whole sharded run was a clean soak
+
+
+@functools.partial(jax.jit, static_argnames=("g", "mesh"))
+def _kglobal_sharded(mesh, g, gid, mc, me, mh, mx, ms):
+    specs = tuple(kleaf_spec(a) for a in (gid, mc, me, mh, mx, ms))
+
+    def local(gid, mc, me, mh, mx, ms):
+        real = gid < g
+
+        def tot(a):
+            return jax.lax.psum(jnp.sum(jnp.where(real, a, 0)), AXIS)
+
+        return GlobalKMetrics(
+            rounds=tot(mc),
+            elections=tot(me),
+            hist=jax.lax.psum(
+                jnp.sum(jnp.where(real[None], mh, 0), axis=(1, 2)), AXIS),
+            max_latency=jax.lax.pmax(
+                jnp.max(jnp.where(real, mx, 0)), AXIS),
+            unsafe=tot(1 - ms),
+        )
+
+    f = _shard_map(local, mesh=mesh, in_specs=specs,
+                   out_specs=GlobalKMetrics(P(), P(), P(), P(), P()))
+    return f(gid, mc, me, mh, mx, ms)
+
+
+def kglobal_sharded(cfg: RaftConfig, leaves, g: int, mesh: Mesh
+                    ) -> GlobalKMetrics:
+    """Reduce the wire's metric tail with psum/pmax at the mesh
+    boundary — group state never leaves its device; five scalars and
+    one [H] row do. Pad groups (group id >= g) are masked out on-device
+    before the reduction, so the counters equal the host-side
+    `kcommitted`/`kelections`/`khist` values exactly (i32 adds
+    reassociate). Module-level jit (like `_kstep_sharded`): repeated
+    calls at one (g, mesh, shape) reuse a single compiled reduction."""
+    gid = leaves[pkernel._n_state_leaves(cfg) - 1]
+    tail = [pkernel._mleaf(leaves, n)
+            for n in ("committed", "elections", "hist", "max_latency",
+                      "safety")]
+    return _kglobal_sharded(mesh, int(g), gid, *tail)
+
+
+def prun_sharded(cfg: RaftConfig, st: State, n_ticks: int, mesh: Mesh,
+                 t0: int = 0, metrics: Metrics | None = None,
+                 interpret: bool = False, flight: Flight | None = None):
+    """Drop-in for `pkernel.prun` with the groups axis data-parallel
+    over `mesh`: same (State, Metrics[, Flight]) out, same bits —
+    sharding must be invisible in every leaf. Raises ValueError when
+    the shape is unsupported for this device count (per-device VMEM or
+    HBM budget)."""
+    g = st.alive_prev.shape[0]
+    wf = flight is not None
+    if not pkernel.supported(cfg, n_groups=g, n_devices=mesh.size,
+                             with_flight=wf):
+        raise ValueError(
+            f"pkernel: shape unsupported on {mesh.size} device(s) "
+            f"(k > 30, VMEM footprint {pkernel.kernel_vmem_bytes(cfg)} B "
+            f"> {pkernel.VMEM_LIMIT_BYTES} B, or per-device HBM "
+            f"{pkernel.hbm_bytes(cfg, g, mesh.size, with_flight=wf)} B "
+            f"> {pkernel.HBM_LIMIT_BYTES} B) — use the XLA path")
+    leaves, g = kinit_sharded(cfg, st, mesh, metrics, flight)
+    leaves = kstep_sharded(cfg, leaves, t0, n_ticks, mesh,
+                           interpret=interpret)
+    if flight is None:
+        return pkernel.kfinish(cfg, leaves, g, metrics)
+    st2, met = pkernel.kfinish(cfg, leaves, g, metrics)
+    return st2, met, pkernel.kflight(cfg, leaves, g)

@@ -29,6 +29,26 @@ from raft_tpu.sim.state import Mailbox, PerNode, State
 
 _VERSION = 1
 
+# Metric leaves with a leading [G] axis — these follow the State's
+# sharding on load; the scalars and the global [H] histogram replicate
+# (discriminated by NAME, not shape: at G == HIST_SIZE a shape test
+# would shard the histogram by accident).
+_PER_GROUP_METRICS = ("committed", "leaderless", "safety")
+
+
+def _shard_metrics(metrics: Metrics, sharding) -> Metrics:
+    """Reshard loaded metrics like the State: per-group leaves onto the
+    mesh, the rest replicated. Only NamedShardings carry a mesh to
+    replicate over; any other placement is applied to the State alone."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    if not isinstance(sharding, NamedSharding):
+        return metrics
+    rep = NamedSharding(sharding.mesh, PartitionSpec())
+    return Metrics(**{
+        f: jax.device_put(getattr(metrics, f),
+                          sharding if f in _PER_GROUP_METRICS else rep)
+        for f in Metrics._fields})
+
 
 def _flatten(prefix: str, obj, out: dict):
     if hasattr(obj, "_fields"):   # NamedTuple node
@@ -91,7 +111,10 @@ def load(path, cfg: Optional[RaftConfig] = None, sharding=None
     elastic-recovery path: a checkpoint written by an n-device run
     resumes on an m-device mesh of any divisor of G, because the npz is
     device-layout-free and `State.group_id` travels with the shard
-    (`tests/test_checkpoint.py::test_resume_onto_different_mesh`)."""
+    (`tests/test_checkpoint.py::test_resume_onto_different_mesh`).
+    Saved metrics reshard along: per-group leaves follow the state, the
+    scalars/histogram replicate (the dryrun's 1-device-checkpoint ->
+    n-device-mesh hop rides this path)."""
     with np.load(path) as z:
         version = int(z["__version__"])
         if version != _VERSION:
@@ -126,4 +149,6 @@ def load(path, cfg: Optional[RaftConfig] = None, sharding=None
             metrics = Metrics(**md)
     if sharding is not None:
         st = jax.device_put(st, sharding)
+        if metrics is not None:
+            metrics = _shard_metrics(metrics, sharding)
     return st, t, metrics

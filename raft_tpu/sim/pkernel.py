@@ -111,6 +111,33 @@ LANE = 128   # lane width: trailing dim of every k-state leaf
 SUB = 8      # sublanes per block (min: block sublane dim must be 8-divisible)
 GB = SUB * LANE   # groups per block (1024): ~5 MB of VMEM state/block
 VMEM_LIMIT_BYTES = 100 * 1024 * 1024   # budget passed to the compiler
+# Per-chip HBM budget for the wire-form model (supported()/hbm_bytes).
+# Defaults to the TPU v5 lite's 16 GiB; a driver on a larger-HBM part
+# (v4: 32 GB, v5p: 95 GB) raises it via $RAFT_TPU_HBM_BYTES rather
+# than this module probing device memory_stats itself — on this image
+# touching the TPU plugin from a CPU process can hang (conftest.py).
+# Read ONCE at import (a constant, like the VMEM budget): set the env
+# var before the first raft_tpu import; mutating os.environ afterwards
+# has no effect.
+import os as _os
+HBM_LIMIT_BYTES = int(_os.environ.get("RAFT_TPU_HBM_BYTES",
+                                      16 * 1024 ** 3))
+
+
+def _state_words_per_group(cfg: RaftConfig) -> int:
+    """i32 words per group of the NON-ROW wire leaves: node state,
+    mailbox, alive_prev ([K, ...]: k words), group_id, and the
+    per-group metric lanes (every metric leaf except the [H]-row
+    hist). The one accumulation both byte models share — the VMEM and
+    HBM predicates drifted apart once (alive_prev counted as 1 word in
+    one copy) and tests/test_kmesh.py pins this shared form against
+    real kinit leaves."""
+    words = 0
+    for _, kind in _node_leaves(cfg):
+        words += cfg.k * {"scalar": 1, "peer": cfg.k,
+                          "ring": cfg.log_cap}[kind]
+    words += len(_mb_fields(cfg)) * cfg.k * cfg.k
+    return words + cfg.k + 1 + (N_METRIC_LEAVES - 1)
 
 
 def kernel_vmem_bytes(cfg: RaftConfig) -> int:
@@ -123,22 +150,58 @@ def kernel_vmem_bytes(cfg: RaftConfig) -> int:
     live in the fori_loop carry/vregs. A coarse model — it only has to
     reject shapes that would OOM the 100 MB budget by integer factors
     (huge L or K), not referee marginal fits."""
-    words = 0
-    for _, kind in _node_leaves(cfg):
-        words += cfg.k * {"scalar": 1, "peer": cfg.k,
-                          "ring": cfg.log_cap}[kind]
-    words += len(_mb_fields(cfg)) * cfg.k * cfg.k
-    # alive_prev + group_id + the per-group metric lanes (every metric
-    # leaf except the [H]-row hist, counted separately below).
-    words += 2 + (N_METRIC_LEAVES - 1)
     # hist rows + the flight-recorder rows (reserved whether or not the
     # caller passes a flight — the predicate must not flip per call).
-    block = (words * 4 * GB + HIST_SIZE * 4 * SUB * LANE
+    block = (_state_words_per_group(cfg) * 4 * GB
+             + HIST_SIZE * 4 * SUB * LANE
              + len(FLIGHT_LEAVES) * FLIGHT_RING * 4 * SUB * LANE)
     return 5 * block
 
 
-def supported(cfg: RaftConfig) -> bool:
+def wire_words_per_group(cfg: RaftConfig, with_flight: bool = True) -> int:
+    """i32 words per group of the kernel wire form: node + mailbox
+    leaves, alive_prev + group_id, the per-group metric lanes INCLUDING
+    the [H]-row in-kernel histogram, and (by default — `kinit` reserves
+    the predicate for it whether or not a flight rides) the six
+    flight-recorder ring rows. This is the HBM cost model the mesh-aware
+    `supported()` and `scripts/layout_probe.py` share; note the
+    histogram (HIST_SIZE words) and flight rings (6 x RING words) are
+    per-GROUP on the wire, unlike the XLA path's global [H] histogram —
+    the biggest non-state contributors to the G ceiling (DESIGN.md §9)."""
+    words = _state_words_per_group(cfg) + HIST_SIZE
+    if with_flight:
+        words += len(FLIGHT_LEAVES) * FLIGHT_RING
+    return words
+
+
+def hbm_bytes(cfg: RaftConfig, n_groups: int, n_devices: int = 1,
+              with_flight: bool = True) -> int:
+    """Peak per-device HBM bytes a sharded kernel run needs: the
+    per-device group count padded to a whole block, times the wire
+    words, times 2 — pallas_call allocates fresh output buffers, so an
+    input and an output copy of every leaf are live across a launch
+    (no donation; DESIGN.md §9 names aliasing as the next 2x).
+    `with_flight=False` models a run without the flight-recorder ring
+    (the ring leaves exist on the wire only when kinit gets one)."""
+    padded = (-(-n_groups // (n_devices * GB))) * GB
+    return 2 * 4 * wire_words_per_group(cfg, with_flight) * padded
+
+
+def hbm_ceiling_groups(cfg: RaftConfig, n_devices: int = 1,
+                       with_flight: bool = True) -> int:
+    """Largest group count `supported(..., with_flight=...)` admits on
+    `n_devices`: whole 1024-group blocks only, consistent with
+    `hbm_bytes`'s padding — an unpadded HBM / bytes-per-group division
+    over-promises by up to a block, and a sweep sized at that figure
+    would be rejected by the very predicate that published it. The
+    single source for every printed/emitted ceiling (layout_probe,
+    multichip_sweep)."""
+    per_block = 2 * 4 * wire_words_per_group(cfg, with_flight) * GB
+    return (HBM_LIMIT_BYTES // per_block) * GB * n_devices
+
+
+def supported(cfg: RaftConfig, n_groups: int | None = None,
+              n_devices: int = 1, with_flight: bool = True) -> bool:
     """Every batched-path feature is in-kernel: fault classes,
     scheduled reads, membership change, PreVote, leadership transfer,
     and the election-latency histogram — each statically gated exactly
@@ -148,8 +211,22 @@ def supported(cfg: RaftConfig) -> bool:
     lanes (k <= 30 so `1 << k` and the config SWAR popcount stay exact),
     and the per-block VMEM footprint must fit the compiler budget —
     a [K, L] shape big enough to blow it (e.g. L in the thousands)
-    needs the XLA path, which streams through HBM instead."""
-    return cfg.k <= 30 and kernel_vmem_bytes(cfg) <= VMEM_LIMIT_BYTES
+    needs the XLA path, which streams through HBM instead.
+
+    Mesh-aware form: pass `n_groups` (and the device count the caller
+    will shard over) and the predicate also requires the per-device
+    wire-form footprint to fit HBM (`hbm_bytes`) — this is what turns
+    "1M groups on one chip" from a Mosaic OOM into a clean False, and
+    what the multichip sweep uses to mark unsupported grid cells.
+    `with_flight=False` budgets a flight-ring-less run (prun passes
+    the actual flight argument through); the budget itself defaults to
+    16 GiB and follows $RAFT_TPU_HBM_BYTES on larger-HBM parts."""
+    if not (cfg.k <= 30 and kernel_vmem_bytes(cfg) <= VMEM_LIMIT_BYTES):
+        return False
+    if n_groups is None:
+        return True
+    return hbm_bytes(cfg, n_groups, n_devices, with_flight) \
+        <= HBM_LIMIT_BYTES
 
 
 # ----------------------------------------------------------- small helpers
@@ -1411,23 +1488,29 @@ def _prun_padded(cfg, leaves, t0, n_ticks, interpret=False):
 
 
 def kinit(cfg: RaftConfig, st: State, metrics: Metrics | None = None,
-          flight: Flight | None = None):
+          flight: Flight | None = None, pad_to: int = GB):
     """Convert (State, Metrics[, Flight]) to the kernel wire form ONCE.
     Returns (leaves, g): `leaves` is the flat tuple `kstep` launches on,
     `g` the unpadded group count. Passing a `flight`
     (obs.recorder.flight_init) turns on the in-kernel flight-recorder
     ring — its six leaves ride the wire between the group ids and the
-    metric tail, and `kflight` reads them back. The conversion
-    transposes the whole state; at 100K groups it costs more than a
-    200-tick kernel launch, so chunked drivers must call kinit/kfinish
-    once around the chunk loop, never per chunk (that mistake hid the
-    kernel's speed behind 2s/chunk of host-side reshuffling when first
-    measured)."""
+    metric tail, and `kflight` reads them back. `pad_to` rounds the
+    padded group count up to a multiple of its value (itself a multiple
+    of the GB block size): the sharded driver (parallel/kmesh.py) passes
+    n_devices * GB so every device shard holds whole blocks. The
+    conversion transposes the whole state; at 100K groups it costs more
+    than a 200-tick kernel launch, so chunked drivers must call
+    kinit/kfinish once around the chunk loop, never per chunk (that
+    mistake hid the kernel's speed behind 2s/chunk of host-side
+    reshuffling when first measured)."""
     from raft_tpu.sim.run import metrics_init
+    if pad_to % GB:
+        raise ValueError(f"pad_to={pad_to} must be a multiple of the "
+                         f"{GB}-group block")
     g = st.alive_prev.shape[0]
     if metrics is None:
         metrics = metrics_init(g)
-    pad = (-g) % GB
+    pad = (-g) % pad_to
     if pad:
         # Pad groups simulate alongside (results sliced off at finish);
         # their group ids continue past g, keeping seed streams distinct.
@@ -1584,12 +1667,16 @@ def prun(cfg: RaftConfig, st: State, n_ticks: int, t0: int = 0,
     the in-kernel ring rides along and a (State, Metrics, Flight)
     triple comes back. One launch + both conversions — for chunked
     loops use kinit/kstep/kfinish directly. Raises ValueError on
-    unsupported shapes (supported())."""
-    if not supported(cfg):
+    unsupported shapes (supported(), single-device HBM budget included
+    — the group count is in hand here)."""
+    g = st.alive_prev.shape[0]
+    wf = flight is not None
+    if not supported(cfg, n_groups=g, with_flight=wf):
         raise ValueError(
-            "pkernel: shape unsupported (k > 30 or VMEM footprint "
-            f"{kernel_vmem_bytes(cfg)} B > {VMEM_LIMIT_BYTES} B) — "
-            "use the XLA path (run.run)")
+            "pkernel: shape unsupported (k > 30, VMEM footprint "
+            f"{kernel_vmem_bytes(cfg)} B > {VMEM_LIMIT_BYTES} B, or "
+            f"single-device HBM {hbm_bytes(cfg, g, with_flight=wf)} B > "
+            f"{HBM_LIMIT_BYTES} B) — use the XLA path (run.run)")
     leaves, g = kinit(cfg, st, metrics, flight)
     leaves = kstep(cfg, leaves, t0, n_ticks, interpret=interpret)
     if flight is None:

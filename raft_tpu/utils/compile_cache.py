@@ -1,0 +1,33 @@
+"""One shared recipe for the on-disk XLA compile cache.
+
+Compiles on the CPU build box are the wall (minutes per program, 20+
+in its slow mode), so every driver that can reuse the test suite's
+cache must point at the SAME directory with the SAME threshold —
+tests/conftest.py, the dryrun subprocess, and scripts/multichip_sweep
+all do. This helper is the single copy of that recipe; a second
+hand-rolled copy that drifts silently turns the shared-warm-compile
+design (e.g. parallel/kmesh.faulted_64_cfg) back into cold compiles.
+"""
+
+from __future__ import annotations
+
+import os
+
+# tests/.jax_cache at the repo root — machine-local, gitignored.
+DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tests", ".jax_cache")
+
+
+def enable(cache_dir: str | None = None,
+           min_compile_secs: float = 1.0) -> str:
+    """Point jax's persistent compilation cache at the repo's shared
+    directory (or `cache_dir`). Call AFTER `import jax` and any
+    platform pinning; returns the directory used."""
+    import jax
+
+    cache_dir = cache_dir or DEFAULT_DIR
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)
+    return cache_dir

@@ -21,6 +21,14 @@ Run on the real TPU (the driver's job):
     python scripts/kernel_sweep.py
 CPU smoke (interpret mode, small shape — minutes per universe):
     python scripts/kernel_sweep.py --interpret --groups 8 --ticks 48
+Sharded kernel (`--devices N`, DESIGN.md §9): every universe runs
+through the shard_map'd engine (parallel/kmesh.py) instead of the
+single-device kstep. On a box with fewer devices than N the script
+re-execs itself on an N-device virtual CPU platform (the same
+xla_force_host_platform_device_count trick tests/conftest.py and the
+dryrun use), so the pairwise feature x fault matrix also covers the
+sharded path:
+    python scripts/kernel_sweep.py --devices 8 --interpret --groups 16 --ticks 48
 """
 
 from __future__ import annotations
@@ -86,15 +94,25 @@ def sweep_configs(base_seed: int):
 
 
 def run_universe(cfg: RaftConfig, n_groups: int, ticks: int,
-                 interpret: bool):
+                 interpret: bool, devices: int = 1):
     """(ok, detail, seconds, unsafe) for one universe's kernel-vs-XLA
     check. `unsafe` counts groups whose per-tick safety bit dropped —
     each universe doubles as an n_groups x ticks safety soak, so the
-    sweep log is soak evidence, not just divergence evidence."""
+    sweep log is soak evidence, not just divergence evidence. With
+    `devices > 1` the kernel half runs shard_map'd over a device mesh
+    (parallel/kmesh.py) — the XLA reference stays unsharded, so the
+    comparison also certifies that sharding is invisible."""
     t0 = time.perf_counter()
     st0 = sim.init(cfg, n_groups=n_groups)
     stx, mx = run(cfg, st0, ticks, 0, metrics_init(n_groups))
-    stp, mp = pkernel.prun(cfg, st0, ticks, interpret=interpret)
+    if devices > 1:
+        from raft_tpu import parallel
+        from raft_tpu.parallel import kmesh
+        mesh = parallel.make_mesh(devices)
+        stp, mp = kmesh.prun_sharded(cfg, st0, ticks, mesh,
+                                     interpret=interpret)
+    else:
+        stp, mp = pkernel.prun(cfg, st0, ticks, interpret=interpret)
     s_ok, s_why = trees_equal_why(stx, stp)
     m_ok, m_why = trees_equal_why(
         mx, mp, names=list(type(mx)._fields))
@@ -107,6 +125,21 @@ def run_universe(cfg: RaftConfig, n_groups: int, ticks: int,
             dt, unsafe)
 
 
+def _reexec_with_host_devices(n_devices: int) -> int:
+    """Re-run this script in a child whose env forces an n-device
+    virtual CPU platform BEFORE jax initializes (the flag is read at
+    first backend init — same mechanism as __graft_entry__'s dryrun)."""
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAFT_TPU_SWEEP_REEXEC"] = "1"   # one hop only, never recurse
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return subprocess.run([sys.executable] + sys.argv, env=env).returncode
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--groups", type=int, default=512)
@@ -115,12 +148,35 @@ def main():
                     help="base seed; universe n uses seed+n")
     ap.add_argument("--interpret", action="store_true",
                     help="pallas interpret mode (CPU smoke; no TPU)")
+    ap.add_argument("--devices", type=int, default=1,
+                    help="shard the kernel over this many devices "
+                    "(re-execs onto a virtual CPU platform if the box "
+                    "has fewer)")
     args = ap.parse_args()
     _check_pairwise(ROWS)
 
+    if args.devices > 1 and len(jax.devices()) < args.devices:
+        if jax.devices()[0].platform == "tpu":
+            # Never swap a real TPU for virtual CPUs: a 4-chip box
+            # asked for --devices 8 should say so, not silently
+            # validate the wrong hardware (make_mesh's rule).
+            print(f"only {len(jax.devices())} TPU chip(s) visible, "
+                  f"--devices {args.devices} requested; run with "
+                  f"--devices {len(jax.devices())} or on a larger "
+                  f"slice", file=sys.stderr)
+            return 2
+        if os.environ.get("RAFT_TPU_SWEEP_REEXEC"):
+            print(f"need {args.devices} devices, still have "
+                  f"{len(jax.devices())} after the re-exec (a TPU plugin "
+                  f"that ignores JAX_PLATFORMS?)", file=sys.stderr)
+            return 2
+        return _reexec_with_host_devices(args.devices)
+
     dev = jax.devices()[0]
     print(f"platform: {dev.platform} ({dev.device_kind}); "
-          f"{args.groups} groups x {args.ticks} ticks per universe",
+          f"{args.groups} groups x {args.ticks} ticks per universe"
+          + (f"; kernel sharded over {args.devices} devices"
+             if args.devices > 1 else ""),
           file=sys.stderr, flush=True)
     if not args.interpret and dev.platform != "tpu":
         print("no TPU attached: pass --interpret (and a small "
@@ -131,12 +187,15 @@ def main():
     for n, cfg in enumerate(sweep_configs(args.seed)):
         feats = "+".join(f for f, on in zip(FACTORS, ROWS[n]) if on) \
             or "faults-only"
-        if not pkernel.supported(cfg):
+        # Sweep universes carry no flight ring: budget the flight-off
+        # model, matching run_universe's flightless prun/prun_sharded.
+        if not pkernel.supported(cfg, args.groups, args.devices,
+                                 with_flight=False):
             print(f"[{n}] k={cfg.k} L={cfg.log_cap} {feats}: UNSUPPORTED "
                   f"shape (skipped)", flush=True)
             continue
         ok, detail, dt, unsafe = run_universe(cfg, args.groups, args.ticks,
-                                              args.interpret)
+                                              args.interpret, args.devices)
         tag = "ok" if ok else "DIVERGED"
         safe_tag = "ok" if unsafe == 0 else f"VIOLATED({unsafe} groups)"
         print(f"[{n}] seed={cfg.seed} k={cfg.k} L={cfg.log_cap} "
@@ -151,7 +210,9 @@ def main():
         return 1
     print(f"sweep clean: every universe bit-identical; per-tick safety "
           f"bit held across all {swept} universes "
-          f"({args.groups} groups x {args.ticks} ticks each)",
+          f"({args.groups} groups x {args.ticks} ticks each"
+          + (f", kernel sharded over {args.devices} devices)"
+             if args.devices > 1 else ")"),
           file=sys.stderr)
     return 0
 

@@ -1,4 +1,5 @@
-"""Layout experiment: batch-major vs batch-minor for the tick's op mix.
+"""Layout experiment: batch-major vs batch-minor for the tick's op mix,
+plus the measured bytes/group report behind the G-ceiling math.
 
 The batched state is `[G, K, L]` (G ~ 1e5 groups, K = 5 replicas,
 L = 32 ring slots). XLA tiles the two MINOR dims onto the TPU's
@@ -10,14 +11,28 @@ This probe times the same per-node one-hot select/reduce chain (the
 phase-D workhorse pattern) under both layouts via vmap in_axes alone —
 identical trace, different physical layout — to decide whether flipping
 the state layout is worth the refactor. Results recorded in DESIGN.md §7.
+
+`--bytes-only` (or just reading the report the default run prints
+first) gives the per-leaf bytes/group of the State pytree AND of the
+kernel wire form, with the single-chip G ceiling each implies per
+16 GiB HBM — the measured starting point for the packed-state-layout
+work (ROADMAP item on cutting bytes/group) and the multichip sweep's
+`predicted` block (DESIGN.md §9).
 """
 
 from __future__ import annotations
 
+import argparse
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # runnable as `python scripts/...`
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 G, K, L, STEPS, REPS = 100_000, 5, 32, 30, 3
 
@@ -59,7 +74,69 @@ def bench(name, f, lt, idx):
     return per_step_ms
 
 
+def bytes_per_group_report(cfg=None):
+    """Print per-leaf bytes/group for (a) the State pytree the XLA path
+    scans and (b) the kernel wire form (sim/pkernel.py), and the
+    single-chip G ceiling each implies for a 16 GiB HBM. All numbers
+    are derived from the real dtypes/shapes (a 1-group state is
+    materialized and walked), not estimated."""
+    from raft_tpu import sim
+    from raft_tpu.config import RaftConfig
+    from raft_tpu.obs.recorder import RING
+    from raft_tpu.sim import pkernel
+
+    cfg = cfg or RaftConfig(seed=42)
+    st = sim.init(cfg, n_groups=1)
+    print(f"bytes/group, headline config (k={cfg.k}, L={cfg.log_cap}, "
+          f"E={cfg.max_entries_per_msg}):")
+    total = 0
+    rows = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(st)[0]:
+        name = ".".join(getattr(p, "name", str(getattr(p, "idx", "?")))
+                        for p in path)
+        b = np.dtype(leaf.dtype).itemsize * int(np.prod(leaf.shape[1:],
+                                                        dtype=np.int64))
+        rows.append((b, name, str(leaf.dtype), leaf.shape[1:]))
+        total += b
+    rows.sort(reverse=True)
+    for b, name, dt, shp in rows:
+        print(f"  {b:6d} B  {name:28s} {dt}{list(shp)}")
+    print(f"  state total: {total} B/group "
+          f"(+ flight recorder {6 * RING * 4} B/group when recording)")
+
+    wire_nf = 4 * pkernel.wire_words_per_group(cfg, with_flight=False)
+    wire = 4 * pkernel.wire_words_per_group(cfg, with_flight=True)
+    hist_b = 4 * pkernel.HIST_SIZE
+    print(f"kernel wire form: {wire} B/group with the flight ring "
+          f"({wire_nf} B without), of which in-kernel histogram "
+          f"{hist_b} B + flight {wire - wire_nf} B — per-GROUP on the "
+          f"wire, unlike the XLA path's global [H] histogram")
+    hbm = pkernel.HBM_LIMIT_BYTES
+    print(f"implied single-chip G ceiling per {hbm >> 30} GiB HBM "
+          f"(2x in+out buffers, no donation, whole 1024-group blocks "
+          f"— the exact supported() boundary):")
+    print(f"  kernel wire (flight on):  "
+          f"{pkernel.hbm_ceiling_groups(cfg):>9,d} groups")
+    print(f"  kernel wire (flight off): "
+          f"{pkernel.hbm_ceiling_groups(cfg, with_flight=False):>9,d} "
+          f"groups")
+    print(f"  state only (XLA resident set, excl. scan intermediates): "
+          f"{hbm // total:>9,d} groups")
+    for d in (4, 8):
+        print(f"  x{d} devices (kernel, flight on): "
+              f"{pkernel.hbm_ceiling_groups(cfg, n_devices=d):>9,d} groups")
+
+
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bytes-only", action="store_true",
+                    help="print the bytes/group + G-ceiling report and "
+                    "exit (no timing probe)")
+    args = ap.parse_args()
+    bytes_per_group_report()
+    if args.bytes_only:
+        return
+
     print(f"platform: {jax.devices()[0].device_kind}, G={G} K={K} L={L}")
     key = jax.random.PRNGKey(0)
     lt_gkl = jax.random.randint(key, (G, K, L), 0, 5, jnp.int32)

@@ -1,0 +1,403 @@
+"""Multi-chip kernel sweep: G x devices grid for the sharded
+fused-chunk engine, emitting MULTICHIP_r07.json (ROADMAP item 1 /
+DESIGN.md §9).
+
+Grid: G in {200K, 500K, 1M} x devices in {1, 4, 8}. Per cell, on a TPU
+host, the sharded kernel (raft_tpu/parallel/kmesh.py) is timed with the
+bench's warmup/chunk protocol and gated the bench's way — promotion
+requires the FULL State + Metrics pytrees bit-identical to a reference
+at the same tick (three-way where feasible: sharded kernel vs
+single-device kernel vs XLA scan) and a clean per-tick safety fold.
+Cells the per-device HBM budget rejects (`pkernel.supported` mesh-aware
+form) are recorded as unsupported with the modeled byte count — that IS
+the ceiling probe; a cell that passes the model but dies at runtime
+records the error string instead of a number.
+
+On a CPU-only box the grid still comes out, marked rather than omitted:
+each cell runs the sharded XLA path (`parallel.run_sharded`) at a
+scaled-down shape with `mode: "dryrun"`, and one `interpret_gate` block
+runs the shard_map'd Pallas kernel in interpret mode against the
+unsharded kernel and the XLA path (the tests/test_kmesh.py shape, so
+the compile is warm wherever the suite has run). `promoted` is False
+for every such entry. The `predicted` block carries the bytes/group
+model and the implied ceilings either way (scripts/layout_probe.py
+--bytes-only prints the same numbers with a per-leaf breakdown).
+
+    python scripts/multichip_sweep.py                    # full (TPU)
+    python scripts/multichip_sweep.py --quick            # small TPU smoke
+    python scripts/multichip_sweep.py --out MULTICHIP_r07.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # runnable as `python scripts/...`
+
+G_LIST = (200_000, 500_000, 1_000_000)
+D_LIST = (1, 4, 8)
+CHUNK = 200          # ticks per kernel launch (bench.py protocol)
+
+
+# One copy of the virtual-host-platform re-exec (kernel_sweep.py owns
+# it; both sweeps guard recursion with RAFT_TPU_SWEEP_REEXEC).
+from scripts.kernel_sweep import _reexec_with_host_devices  # noqa: E402
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _predicted(cfg):
+    from raft_tpu.sim import pkernel
+    return {
+        "wire_bytes_per_group":
+            4 * pkernel.wire_words_per_group(cfg, with_flight=True),
+        "wire_bytes_per_group_no_flight":
+            4 * pkernel.wire_words_per_group(cfg, with_flight=False),
+        "hbm_limit_bytes": pkernel.HBM_LIMIT_BYTES,
+        # Whole-block ceilings, the same rounding supported() applies —
+        # a sweep sized at exactly this G is admitted, not rejected.
+        # The bench rides the flight ring (flight-on ceiling); the
+        # sweep's own cells are flightless (no-flight ceiling).
+        "single_chip_ceiling_groups": pkernel.hbm_ceiling_groups(cfg),
+        "single_chip_ceiling_groups_no_flight":
+            pkernel.hbm_ceiling_groups(cfg, with_flight=False),
+        "model": "2x (in + out buffers, no donation) x padded groups; "
+                 "see scripts/layout_probe.py --bytes-only for the "
+                 "per-leaf breakdown",
+    }
+
+
+def _gate(cfg, n_groups, ticks, mesh, interpret):
+    """Three-way state_identical gate at (n_groups, ticks): sharded
+    kernel vs single-device kernel (when one device can hold G) vs the
+    XLA scan. Returns (verdicts dict, unsafe count, sharded Metrics)."""
+    import numpy as np
+
+    from raft_tpu import sim
+    from raft_tpu.parallel import kmesh
+    from raft_tpu.sim import pkernel
+    from raft_tpu.sim.run import run, unsafe_groups
+    from raft_tpu.utils.trees import trees_equal_why
+
+    st0 = sim.init(cfg, n_groups=n_groups)
+    leaves, g = kmesh.kinit_sharded(cfg, st0, mesh)
+    leaves = kmesh.kstep_sharded(cfg, leaves, 0, ticks, mesh,
+                                 interpret=interpret)
+    st_sh, m_sh = pkernel.kfinish(cfg, leaves, g)
+    # The psum'd boundary verdicts must agree with the host-side fold;
+    # computed FIRST so the sharded wire buffers can be dropped before
+    # the single-device references run (at the flagship shapes those
+    # need every byte of one chip's HBM for themselves).
+    gm = kmesh.kglobal_sharded(cfg, leaves, g, mesh)
+    assert int(gm.elections) == int(m_sh.elections)
+    # The psum rides i32 lanes (x64 is off on-device), so compare
+    # modulo 2^32: at flagship shapes (1M groups x long gate runs) the
+    # true total can pass 2^31 and the device counter wraps — that is
+    # an i32 representation artifact, not a parity failure. Promoted
+    # throughput numbers always come from the int64 host-side counters
+    # (GlobalKMetrics docstring).
+    host_rounds = int(np.asarray(m_sh.committed).astype(np.int64).sum())
+    assert int(gm.rounds) & 0xFFFFFFFF == host_rounds & 0xFFFFFFFF
+    assert int(gm.unsafe) == unsafe_groups(m_sh)
+    del leaves
+    verdicts = {}
+    if mesh.size > 1 and pkernel.supported(cfg, n_groups, 1,
+                                           with_flight=False):
+        try:
+            st_1, m_1 = pkernel.prun(cfg, st0, ticks, interpret=interpret)
+            ok_s, why_s = trees_equal_why(st_sh, st_1)
+            ok_m, why_m = trees_equal_why(
+                m_sh, m_1, names=list(type(m_sh)._fields))
+            verdicts["vs_kernel_1dev"] = bool(ok_s and ok_m)
+            if not (ok_s and ok_m):
+                log(f"    1dev-kernel mismatch: {why_s or why_m}")
+        except Exception as e:
+            verdicts["vs_kernel_1dev"] = f"error: {type(e).__name__}"
+    try:
+        st_x, m_x = run(cfg, st0, ticks)
+        ok_s, why_s = trees_equal_why(st_x, st_sh)
+        ok_m, why_m = trees_equal_why(
+            m_x, m_sh, names=list(type(m_x)._fields))
+        verdicts["vs_xla"] = bool(ok_s and ok_m)
+        if not (ok_s and ok_m):
+            log(f"    xla mismatch: {why_s or why_m}")
+    except Exception as e:   # XLA at 1M groups can OOM where the kernel fits
+        verdicts["vs_xla"] = f"error: {type(e).__name__}"
+    bool_verdicts = [v for v in verdicts.values() if isinstance(v, bool)]
+    # Tri-state: True = every reference that ran matched; False = a
+    # real divergence; None = NO reference could run (e.g. both OOM at
+    # the 1M flagship cell) — unknown is not a failure, but it is
+    # never promotable either.
+    state_identical = (all(bool_verdicts) if bool_verdicts else None)
+    return ({"state_identical": state_identical, **verdicts},
+            unsafe_groups(m_sh), m_sh)
+
+
+def _time_cell(cfg, n_groups, ticks, mesh):
+    """Bench-protocol timing: 2 warmup chunks (compiles), then timed
+    chunks; rounds/s from the int64 host-side committed delta."""
+    from raft_tpu import sim
+    from raft_tpu.parallel import kmesh
+    from raft_tpu.sim import pkernel
+
+    leaves, g = kmesh.kinit_sharded(cfg, sim.init(cfg, n_groups=n_groups),
+                                    mesh)
+    t0 = time.perf_counter()
+    leaves = kmesh.kstep_sharded(cfg, leaves, 0, CHUNK, mesh)
+    pkernel.kcommitted(leaves, g)
+    leaves = kmesh.kstep_sharded(cfg, leaves, CHUNK, CHUNK, mesh)
+    base = pkernel.kcommitted(leaves, g)
+    warmup_s = time.perf_counter() - t0
+    n_chunks = max(1, ticks // CHUNK)
+    start = time.perf_counter()
+    for c in range(n_chunks):
+        leaves = kmesh.kstep_sharded(cfg, leaves, (c + 2) * CHUNK, CHUNK,
+                                     mesh)
+    rounds = pkernel.kcommitted(leaves, g) - base   # fetch closes the timer
+    elapsed = time.perf_counter() - start
+    _, met = pkernel.kfinish(cfg, leaves, g)
+    from raft_tpu.sim.run import unsafe_groups
+    return {"rounds": rounds, "timed_ticks": n_chunks * CHUNK,
+            "timed_wall_s": round(elapsed, 3),
+            "warmup_wall_s": round(warmup_s, 3),
+            "rounds_per_sec": round(rounds / elapsed, 1),
+            "timed_unsafe_groups": unsafe_groups(met)}
+
+
+def tpu_cell(cfg, n_groups, n_devices, ticks, gate_ticks):
+    """One (G, D) grid cell on real chips."""
+    from raft_tpu import parallel
+    from raft_tpu.sim import pkernel
+
+    cell = {"groups": n_groups, "devices": n_devices, "mode": "tpu",
+            "promoted": False}
+    # The sweep's runs carry no flight ring, so gate and report the
+    # flight-off model — the flight-on budget would reject the
+    # 1.03M-1.27M-group band this probe exists to measure.
+    if not pkernel.supported(cfg, n_groups, n_devices, with_flight=False):
+        cell["status"] = "unsupported"
+        cell["hbm_bytes_per_device"] = pkernel.hbm_bytes(
+            cfg, n_groups, n_devices, with_flight=False)
+        cell["hbm_limit_bytes"] = pkernel.HBM_LIMIT_BYTES
+        log(f"  [{n_groups}g x {n_devices}d] unsupported: modeled "
+            f"{cell['hbm_bytes_per_device']:,} B/device > budget")
+        return cell
+    try:
+        mesh = parallel.make_mesh(n_devices)
+        verdicts, unsafe, _ = _gate(cfg, n_groups, gate_ticks, mesh,
+                                    interpret=False)
+        cell.update(verdicts)
+        cell["gate_ticks"] = gate_ticks
+        cell["safety_ok"] = unsafe == 0
+        cell["unsafe_groups"] = unsafe
+        cell.update(_time_cell(cfg, n_groups, ticks, mesh))
+        cell["safety_ok"] = cell["safety_ok"] \
+            and cell["timed_unsafe_groups"] == 0
+        cell["promoted"] = bool(cell["state_identical"]
+                                and cell["safety_ok"])
+        cell["status"] = "ok"
+        log(f"  [{n_groups}g x {n_devices}d] "
+            f"{cell['rounds_per_sec']:,.0f} rounds/s "
+            f"(state_identical={cell['state_identical']} "
+            f"safety_ok={cell['safety_ok']})")
+    except Exception as e:
+        # THE ceiling probe: a cell the model admits but the runtime
+        # rejects names its killer here (Mosaic OOM, HBM allocator, ...).
+        cell["status"] = f"error: {type(e).__name__}: {e}"
+        log(f"  [{n_groups}g x {n_devices}d] FAILED: {cell['status']}")
+    return cell
+
+
+# CPU stand-in universe for dryrun cells AND the interpret gate: the
+# shared kmesh.faulted_64_cfg() k=3/L=8 shape. Deliberately NOT the
+# headline config — a k=5/L=32 scan program costs many minutes of XLA
+# compile on the CPU box (20+ in its slow mode), while this one is
+# seconds-to-a-minute and warm in tests/.jax_cache wherever the test
+# suite or dryrun has run. The cell records the scaled config next to
+# the requested grid coordinates.
+def _dry_cfg():
+    from raft_tpu.parallel import kmesh
+    return kmesh.faulted_64_cfg()
+
+
+def dryrun_cell(n_groups, n_devices, dry_ticks):
+    """CPU stand-in for a grid cell: the sharded XLA path at the scaled
+    universe, gated against the unsharded XLA run. Marks itself."""
+    import numpy as np
+
+    from raft_tpu import parallel, sim
+    from raft_tpu.sim.run import run
+    from raft_tpu.utils.trees import trees_equal_why
+
+    cfg = _dry_cfg()
+    cell = {"groups": n_groups, "devices": n_devices, "mode": "dryrun",
+            "promoted": False,
+            "run": {"groups": cfg.n_groups, "ticks": dry_ticks,
+                    "k": cfg.k, "log_cap": cfg.log_cap,
+                    "engine": "xla-shard_map"}}
+    t0 = time.perf_counter()
+    mesh = parallel.make_mesh(n_devices)
+    st = parallel.shard_state(sim.init(cfg), mesh)
+    st, gm = parallel.run_sharded(cfg, st, dry_ticks, mesh)
+    ref, m_ref = run(cfg, sim.init(cfg), dry_ticks)
+    ok, why = trees_equal_why(ref, st)
+    cell["state_identical"] = bool(
+        ok and int(gm.rounds) == int(np.asarray(m_ref.committed).sum()))
+    if not ok:
+        log(f"    dryrun mismatch: {why}")
+    cell["safety_ok"] = int(gm.unsafe) == 0
+    cell["rounds"] = int(gm.rounds)
+    cell["wall_s"] = round(time.perf_counter() - t0, 3)
+    cell["status"] = "ok"
+    log(f"  [{n_groups}g x {n_devices}d] dryrun at {cfg.n_groups}g x "
+        f"{dry_ticks}t: state_identical={cell['state_identical']} "
+        f"safety_ok={cell['safety_ok']}")
+    return cell
+
+
+def interpret_gate(n_devices: int):
+    """The sharded-KERNEL differential a CPU box can afford: interpret
+    mode at the tests/test_kmesh.py shape (warm compile cache), 3-way
+    vs the unsharded kernel and the XLA path."""
+    from raft_tpu import parallel
+
+    cfg = _dry_cfg()
+    mesh = parallel.make_mesh(n_devices)
+    t0 = time.perf_counter()
+    verdicts, unsafe, _ = _gate(cfg, cfg.n_groups, 48, mesh,
+                                interpret=True)
+    return {"mode": "interpret", "devices": n_devices,
+            "groups": cfg.n_groups, "ticks": 48, **verdicts,
+            "safety_ok": unsafe == 0,
+            "wall_s": round(time.perf_counter() - t0, 3)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="MULTICHIP_r07.json")
+    ap.add_argument("--ticks", type=int, default=600,
+                    help="timed ticks per TPU cell (bench headline: 600)")
+    ap.add_argument("--gate-ticks", type=int, default=200,
+                    help="ticks for the state_identical gate universe")
+    ap.add_argument("--quick", action="store_true",
+                    help="TPU smoke: one small G, 200 timed ticks")
+    ap.add_argument("--dry-ticks", type=int, default=48,
+                    help="ticks for the scaled CPU dryrun cells")
+    args = ap.parse_args()
+
+    max_d = max(D_LIST)
+    import jax
+    if jax.devices()[0].platform != "tpu" \
+            and len(jax.devices()) < max_d:
+        if os.environ.get("RAFT_TPU_SWEEP_REEXEC"):
+            log(f"still {len(jax.devices())} devices after re-exec")
+            return 2
+        return _reexec_with_host_devices(max_d)
+    if jax.devices()[0].platform != "tpu":
+        jax.config.update("jax_platforms", "cpu")
+        from raft_tpu.utils import compile_cache
+        compile_cache.enable()   # the shared tests/.jax_cache recipe
+
+    from raft_tpu.config import RaftConfig
+
+    cfg = RaftConfig(seed=42)   # the config-5 headline universe
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    n_avail = len(jax.devices())
+    log(f"platform: {dev.platform} ({dev.device_kind}), {n_avail} "
+        f"device(s); mode: {'tpu' if on_tpu else 'cpu dryrun'}")
+
+    g_list = (1024,) if args.quick else G_LIST
+    grid = []
+    dry_by_d = {}   # one scaled run per device count; G rows share it
+    for n_groups in g_list:
+        for n_devices in D_LIST:
+            if on_tpu:
+                if n_devices > n_avail:
+                    grid.append({"groups": n_groups, "devices": n_devices,
+                                 "mode": "tpu", "promoted": False,
+                                 "status": f"skipped: only {n_avail} "
+                                 f"chip(s) attached"})
+                    continue
+                grid.append(tpu_cell(cfg, n_groups, n_devices,
+                                     args.ticks, args.gate_ticks))
+            else:
+                # The artifact must come out marked, never be aborted
+                # (docstring contract) — mirror tpu_cell's per-cell
+                # error capture on the CPU path too.
+                if n_devices not in dry_by_d:
+                    try:
+                        dry_by_d[n_devices] = dryrun_cell(
+                            n_groups, n_devices, args.dry_ticks)
+                    except Exception as e:
+                        dry_by_d[n_devices] = {
+                            "devices": n_devices, "mode": "dryrun",
+                            "promoted": False,
+                            "status": f"error: {type(e).__name__}: {e}"}
+                        log(f"  [{n_devices}d] dryrun FAILED: "
+                            f"{dry_by_d[n_devices]['status']}")
+                grid.append({**dry_by_d[n_devices], "groups": n_groups})
+
+    gate = None
+    if not on_tpu:
+        log("interpret-mode sharded-kernel gate (8 devices, 64 groups):")
+        try:
+            gate = interpret_gate(max_d)
+            log(f"  state_identical={gate['state_identical']} "
+                f"safety_ok={gate['safety_ok']} ({gate['wall_s']}s)")
+        except Exception as e:
+            # Tri-state convention: an ERROR is recorded evidence
+            # (None = unknown), not a divergence verdict (False) — a
+            # flaky compile must not read as "the sharded kernel
+            # diverged" in the artifact or the exit code.
+            gate = {"mode": "interpret", "devices": max_d,
+                    "state_identical": None, "safety_ok": None,
+                    "status": f"error: {type(e).__name__}: {e}"}
+            log(f"  interpret gate FAILED: {gate['status']}")
+
+    out = {
+        "schema": 1,
+        "source": "scripts/multichip_sweep.py",
+        "device": f"{dev.platform}:{dev.device_kind}",
+        "n_devices_visible": n_avail,
+        "config_seed": cfg.seed,
+        "mode": "tpu" if on_tpu else "cpu-dryrun",
+        "note": None if on_tpu else (
+            "no TPU attached: grid cells ran the sharded XLA path at "
+            "scaled shapes (mode=dryrun) and the sharded kernel ran in "
+            "interpret mode (interpret_gate); nothing here is a "
+            "throughput claim — promoted=false everywhere"),
+        "predicted": _predicted(cfg),
+        "grid": grid,
+        "interpret_gate": gate,
+    }
+    path = args.out
+    if not os.path.isabs(path):
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), path)
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+        fh.write("\n")
+    log(f"wrote {path}")
+    # Fail on DIVERGENCE or safety violation, never on "no reference
+    # could run" (state_identical=None, the unpromotable-unknown at
+    # flagship shapes) — that cell's evidence is its recorded error.
+    bad = [c for c in grid
+           if c.get("status") == "ok"
+           and (c.get("state_identical") is False
+                or c.get("safety_ok") is False)]
+    if gate is not None and (gate["state_identical"] is False
+                             or gate["safety_ok"] is False):
+        bad.append(gate)   # the only sharded-KERNEL verdict on a CPU box
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

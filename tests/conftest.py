@@ -26,10 +26,12 @@ jax.config.update("jax_platforms", "cpu")
 # The suite's wall time is XLA compile time, not tick execution (~50s
 # compile vs <1s run for a 400-tick differential trace): cache compiled
 # executables on disk so only the first-ever run of each (cfg, shape)
-# program pays it. The cache dir is gitignored and machine-local.
-_cache = os.path.join(os.path.dirname(__file__), ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", _cache)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# program pays it. The cache dir is gitignored and machine-local; the
+# recipe is shared with the dryrun and the multichip sweep so all
+# drivers warm the same entries.
+from raft_tpu.utils import compile_cache  # noqa: E402
+
+compile_cache.enable()
 
 
 # Re-exported for the tests (import must follow the jax env setup

@@ -98,6 +98,40 @@ def test_resume_onto_different_mesh(tmp_path):
     assert _trees_equal(unbroken, st4)
 
 
+def test_one_device_checkpoint_onto_eight_device_mesh(tmp_path):
+    """The dryrun's elastic-load direction (DESIGN.md §9 satellite): a
+    checkpoint written from an UNSHARDED (1-device) state loads straight
+    onto an 8-device mesh — metrics' per-group leaves resharding along —
+    and the sharded run proceeds bit-identically to sharding directly.
+    This is the in-repo assert behind `__graft_entry__`'s checkpoint hop
+    preserving the dryrun golden line."""
+    from raft_tpu import parallel
+
+    cfg = RaftConfig(**CFG)
+    path = tmp_path / "ckpt.npz"
+    checkpoint.save(path, sim.init(cfg, n_groups=16), 0, metrics_init(16),
+                    cfg=cfg)
+
+    mesh8 = parallel.make_mesh(8)
+    st8, t0, m8 = checkpoint.load(
+        path, cfg=cfg, sharding=parallel.state_sharding(mesh8))
+    assert t0 == 0
+    shard_devs = {s.device for s in st8.nodes.term.addressable_shards}
+    assert len(shard_devs) == 8
+    # Per-group metric leaves follow the state's sharding; the scalars
+    # and histogram replicate instead of sharding by accident.
+    assert {s.device for s in m8.committed.addressable_shards} == shard_devs
+    assert len({s.device for s in m8.hist.addressable_shards}) == 8
+    assert all(s.data.shape == m8.hist.shape
+               for s in m8.hist.addressable_shards), \
+        "histogram must replicate, not shard"
+
+    st8, _ = parallel.run_sharded(cfg, st8, 60, mesh8)
+    ref = parallel.shard_state(sim.init(cfg, n_groups=16), mesh8)
+    ref, _ = parallel.run_sharded(cfg, ref, 60, mesh8)
+    assert _trees_equal(ref, st8)
+
+
 def test_resume_in_fresh_process(tmp_path):
     cfg = RaftConfig(**CFG)
     st = sim.init(cfg, n_groups=16)

@@ -1,0 +1,135 @@
+"""Sharded-kernel differential gate (raft_tpu/parallel/kmesh.py): the
+8-way shard_map'd Pallas fused-chunk engine must be bit-identical to
+the unsharded kernel AND the XLA path on a faulted 64-group universe,
+with the psum'd boundary counters equal to the host-side fold — the
+in-repo multi-device evidence for the DESIGN.md §9 engine, on the
+virtual 8-CPU mesh (conftest) in interpret mode.
+
+The universe is `kmesh.faulted_64_cfg()` — the ONE config this suite,
+the dryrun's `dryrun_pallas_mesh` segment, and multichip_sweep share
+(and tests/test_pkernel.py's safety-parity test matches), so the
+unsharded-kernel and XLA reference programs hit the warm compile cache
+and all the drivers share the sharded program."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from conftest import trees_equal as _trees_equal
+from raft_tpu import parallel, sim
+from raft_tpu.config import RaftConfig
+from raft_tpu.parallel import kmesh
+from raft_tpu.sim import pkernel
+from raft_tpu.sim.run import run, unsafe_groups
+
+CFG = kmesh.faulted_64_cfg()
+
+
+def test_supported_is_mesh_aware():
+    """The HBM half of the predicate: a group count one chip cannot
+    hold (2x wire bytes > 16 GiB) is rejected at n_devices=1 and
+    admitted once enough devices share it; the legacy 1-arg form keeps
+    meaning 'per-block VMEM + k fit' only."""
+    cfg = RaftConfig(seed=42)
+    assert pkernel.supported(cfg)
+    bpg = 4 * pkernel.wire_words_per_group(cfg)
+    ceiling = pkernel.HBM_LIMIT_BYTES // (2 * bpg)
+    too_many = 2 * ceiling
+    assert not pkernel.supported(cfg, n_groups=too_many, n_devices=1)
+    assert pkernel.supported(cfg, n_groups=too_many, n_devices=8)
+    # hbm_bytes models whole padded blocks per device.
+    assert pkernel.hbm_bytes(cfg, 1, 1) == 2 * bpg * pkernel.GB
+    assert pkernel.hbm_bytes(cfg, 8 * pkernel.GB, 8) \
+        == pkernel.hbm_bytes(cfg, pkernel.GB, 1)
+
+
+def test_kinit_pad_to_validates_and_pads():
+    st0 = sim.init(CFG)
+    with pytest.raises(ValueError, match="multiple"):
+        pkernel.kinit(CFG, st0, pad_to=pkernel.GB + 1)
+    leaves, g = pkernel.kinit(CFG, st0, pad_to=8 * pkernel.GB)
+    assert g == 64
+    assert leaves[0].shape[-2] * leaves[0].shape[-1] == 8 * pkernel.GB
+
+
+def test_wire_byte_model_matches_real_leaves():
+    """The HBM cost model is pinned to REALITY, not to itself: summing
+    the actual kinit wire-leaf elements per padded group must equal
+    wire_words_per_group, flight off and on. A future wire leaf (the
+    way r07 added the flight ring) that is not taught to the model
+    fails here instead of silently skewing supported()'s G ceiling."""
+    from raft_tpu.obs import flight_init
+
+    st0 = sim.init(CFG)
+    for flight in (None, flight_init(64)):
+        leaves, _ = pkernel.kinit(CFG, st0, flight=flight)
+        actual = sum(int(np.prod(a.shape)) for a in leaves) // pkernel.GB
+        model = pkernel.wire_words_per_group(
+            CFG, with_flight=flight is not None)
+        assert actual == model, (
+            f"wire model {model} words/group != real leaves {actual} "
+            f"(flight={'on' if flight is not None else 'off'})")
+
+
+def test_sharded_kernel_matches_unsharded_and_xla():
+    """The tentpole gate: one 48-tick sharded launch ends bit-identical
+    to both references on full State + Metrics; the wire leaves really
+    live on 8 devices; kglobal's psum verdicts equal the host fold."""
+    st0 = sim.init(CFG)
+    stx, mx = run(CFG, st0, 48)
+    stp, mp = pkernel.prun(CFG, st0, 48, interpret=True)
+
+    mesh = parallel.make_mesh(8)
+    leaves, g = kmesh.kinit_sharded(CFG, st0, mesh)
+    assert g == 64
+    shard_devs = {s.device for s in leaves[0].addressable_shards}
+    assert len(shard_devs) == 8, "wire leaves are not actually sharded"
+    leaves = kmesh.kstep_sharded(CFG, leaves, 0, 48, mesh, interpret=True)
+    sts, ms = pkernel.kfinish(CFG, leaves, g)
+
+    assert _trees_equal(stx, stp) and _trees_equal(mx, mp)
+    assert _trees_equal(stx, sts), "sharded kernel diverged from xla"
+    assert _trees_equal(mx, ms), "sharded kernel metrics diverged"
+    assert int(ms.elections) > 0, "no elections - differential is vacuous"
+    assert unsafe_groups(ms) == 0
+
+    gm = kmesh.kglobal_sharded(CFG, leaves, g, mesh)
+    assert int(gm.rounds) == int(np.asarray(ms.committed)
+                                 .astype(np.int64).sum())
+    assert int(gm.elections) == int(ms.elections)
+    assert int(gm.max_latency) == int(ms.max_latency)
+    assert int(gm.unsafe) == 0
+    assert np.array_equal(np.asarray(gm.hist), np.asarray(ms.hist))
+
+
+def test_sharded_chunk_boundaries_invisible():
+    """Two 24-tick sharded launches == one unbroken 48-tick XLA run:
+    the widened wire state crosses the shard_map + launch boundary
+    intact, and advancing t0 reuses ONE compiled sharded program (the
+    property the bench's timed region rides)."""
+    st0 = sim.init(CFG)
+    stx, mx = run(CFG, st0, 48)
+    mesh = parallel.make_mesh(8)
+    leaves, g = kmesh.kinit_sharded(CFG, st0, mesh)
+    leaves = kmesh.kstep_sharded(CFG, leaves, 0, 24, mesh, interpret=True)
+    leaves = kmesh.kstep_sharded(CFG, leaves, 24, 24, mesh, interpret=True)
+    sts, ms = pkernel.kfinish(CFG, leaves, g)
+    assert _trees_equal(stx, sts)
+    assert _trees_equal(mx, ms)
+
+
+def test_prun_sharded_rejects_over_budget_shapes():
+    """prun_sharded refuses a shape whose per-device HBM footprint
+    cannot fit, naming the budget — before any device allocation."""
+    cfg = RaftConfig(seed=42)
+    bpg = 4 * pkernel.wire_words_per_group(cfg)
+    too_many = 4 * (pkernel.HBM_LIMIT_BYTES // (2 * bpg))
+    mesh = parallel.make_mesh(2)
+
+    class FakeState:   # only .alive_prev.shape[0] is consulted pre-raise
+        class alive_prev:
+            shape = (too_many, 1)
+
+    with pytest.raises(ValueError, match="HBM"):
+        kmesh.prun_sharded(cfg, FakeState(), 1, mesh)

@@ -163,6 +163,13 @@ def test_manifest_roundtrip(tmp_path):
     assert loaded["config"]["seed"] == CFG.seed
     assert loaded["jax"] and loaded["device"] == "cpu:test"
     assert loaded["rate"] == 123.4 and loaded["safety_ok"] is True
+    # Mesh provenance keys exist in EVERY record — null until a caller
+    # fills them, so "one chip" and "unrecorded" stay distinguishable.
+    assert loaded["mesh_shape"] is None
+    assert loaded["groups_per_device"] is None
+    rec2 = emit_manifest("unit-test-mesh", CFG, device="cpu:test",
+                         path="-", mesh_shape=[8], groups_per_device=8)
+    assert rec2["mesh_shape"] == [8] and rec2["groups_per_device"] == 8
     # Appending and hash sensitivity.
     emit_manifest("unit-test-2", RaftConfig(seed=99), device="cpu:test",
                   path=str(path))
