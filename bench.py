@@ -40,6 +40,7 @@ $RAFT_TPU_MANIFEST or ./bench_manifest.jsonl.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import sys
 import time
@@ -48,13 +49,18 @@ import jax
 import numpy as np
 
 from raft_tpu import sim
+# Client traffic subsystem (DESIGN.md §10): open-loop exactly-once
+# sessions measured as client-visible SLO next to raw rounds/s.
+from raft_tpu.clients import exactly_once_report, workload_params
 from raft_tpu.config import RaftConfig
 # Observability layer (DESIGN.md §8): flight recorder rides both
 # engines; every segment emits a JSONL provenance manifest.
 from raft_tpu.obs import (dump_flight, emit_manifest, flight_init,
                           run_recorded)
 from raft_tpu.sim.run import (latency_censored, latency_quantile,
-                              metrics_init, total_rounds, unsafe_groups)
+                              metrics_init, total_client_ops,
+                              total_client_retries, total_rounds,
+                              unsafe_groups)
 # The byte-identical comparator the test suite and kernel sweep gate
 # on, applied at the shapes that produce the headline numbers
 # (VERDICT r05 Missing #1); the `why` names the first divergent leaf.
@@ -258,7 +264,8 @@ def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
         if not (pkernel.supported(cfg, n_groups, nd)
                 and jax.devices()[0].platform == "tpu"):
             return {**fail, "status": "unsupported"}
-        counter_fn = getattr(pkernel, counter_name)
+        counter_fn = functools.partial(
+            getattr(pkernel, counter_name), cfg)
         leaves, g = kinit(sim.init(cfg, n_groups=n_groups))
         t0 = time.perf_counter()
         leaves = kstep(leaves, 0, CHUNK)
@@ -311,6 +318,81 @@ def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
     except Exception as e:   # kernel failure must never kill the bench
         log(f"  [pallas] failed ({type(e).__name__}: {e}); xla stands")
         return {**fail, "status": f"error: {type(e).__name__}"}
+
+
+def _pallas_full_run(cfg, n_groups: int, ticks: int, counter_name: str,
+                     label: str, st_ref, m_ref, f_ref):
+    """Kernel-side FROM-TICK-0 driver shared by the histogram-bearing
+    segments (fault latency, client SLO) — where every tick counts and
+    no reference can be extended, so `_pallas_segment`'s
+    extend-the-reference protocol does not apply. Same subtleties:
+    throwaway-universe warmup (2 compiles, each closed by a counter
+    fetch), the timed chunk loop closed by the counter fetch, then the
+    promotion differential — full State + full Metrics + flight ring
+    bit-identical against the XLA reference at the same tick, flight
+    rings dumped on mismatch. Returns {engine, promoted, k_elapsed,
+    k_warmup_s, state_ok, metrics_ok, flight_ok, nd, k_name}; `engine`
+    is the PROMOTED string ("xla-scan" or an annotated fallback).
+    Kernel failure of ANY kind never raises out."""
+    out = dict(engine="xla-scan", promoted=False, k_elapsed=None,
+               k_warmup_s=None, state_ok=None, metrics_ok=None,
+               flight_ok=None, nd=1, k_name="pallas-fused-chunk")
+    try:
+        from raft_tpu.sim import pkernel
+        nd, k_name, kinit, kstep = _kernel_engine(cfg, n_groups)
+        out["nd"], out["k_name"] = nd, k_name
+        if not (pkernel.supported(cfg, n_groups, nd)
+                and jax.devices()[0].platform == "tpu"):
+            return out
+        counter = functools.partial(getattr(pkernel, counter_name), cfg)
+        t0 = time.perf_counter()
+        wl, wg = kinit(sim.init(cfg, n_groups=n_groups))
+        wl = kstep(wl, 0, CHUNK)
+        counter(wl, wg)
+        wl = kstep(wl, CHUNK, CHUNK)
+        counter(wl, wg)
+        out["k_warmup_s"] = time.perf_counter() - t0
+        log(f"  [pallas] warmup (incl. 2 compiles): "
+            f"{out['k_warmup_s']:.1f}s")
+        leaves, g = kinit(sim.init(cfg, n_groups=n_groups))
+        start = time.perf_counter()
+        at = 0
+        while at < ticks:
+            n = min(CHUNK, ticks - at)
+            leaves = kstep(leaves, at, n)
+            at += n
+        counter(leaves, g)   # fetch closes the timer
+        out["k_elapsed"] = time.perf_counter() - start
+        st_pal, m_pal = pkernel.kfinish(cfg, leaves, g)
+        f_pal = pkernel.kflight(cfg, leaves, g)
+        state_ok, s_why = _trees_equal_why(st_ref, st_pal)
+        metrics_ok, m_why = _trees_equal_why(m_ref, m_pal)
+        flight_ok, f_why = _trees_equal_why(f_ref, f_pal)
+        out.update(state_ok=state_ok, metrics_ok=metrics_ok,
+                   flight_ok=flight_ok)
+        log(f"  [pallas{'' if nd == 1 else f' x{nd}dev'}] {label} "
+            f"{n_groups} groups x {ticks} ticks in "
+            f"{out['k_elapsed']:.2f}s "
+            f"({out['k_elapsed'] / ticks * 1e3:.2f} ms/tick)")
+        if state_ok and metrics_ok and flight_ok:
+            log("  [pallas] differential vs xla at same tick: full State "
+                "+ full Metrics (histograms + safety + client lanes when "
+                "present) + flight ring bit-identical")
+            out.update(engine=k_name, promoted=True)
+        else:
+            log(f"  [pallas] DIFFERENTIAL MISMATCH (state_identical="
+                f"{state_ok} metrics_identical={metrics_ok} "
+                f"flight_identical={flight_ok}) - kernel number discarded")
+            for why in (s_why, m_why, f_why):
+                if why:
+                    log(f"  [pallas] {why}")
+            dump_flight(f_ref, label=f"{label}:xla-ref")
+            dump_flight(f_pal, label=f"{label}:pallas")
+            out["engine"] = "xla-scan (pallas mismatch!)"
+    except Exception as e:   # kernel failure must never kill the bench
+        log(f"  [pallas] failed ({type(e).__name__}: {e}); xla stands")
+        out["engine"] = f"xla-scan (pallas error: {type(e).__name__})"
+    return out
 
 
 def bench_throughput(n_groups: int, ticks: int):
@@ -398,63 +480,14 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
         f"{x_elapsed:.2f}s ({x_elapsed / ticks * 1e3:.2f} ms/tick): "
         f"{rounds} rounds, {n_elections} elections")
 
-    engine, k_elapsed, k_warmup_s = "xla-scan", None, None
-    state_ok = metrics_ok = flight_ok = None
-    elapsed = x_elapsed
-    # Defaults survive an exception before the mesh probe assigns them:
-    # the manifest's mesh fields must be computable on EVERY path.
-    nd, k_name = 1, "pallas-fused-chunk"
-    try:   # kernel failure of ANY kind never kills the bench
-        from raft_tpu.sim import pkernel
-        nd, k_name, kinit, kstep = _kernel_engine(cfg, n_groups)
-        if pkernel.supported(cfg, n_groups, nd) \
-                and jax.devices()[0].platform == "tpu":
-            # Warmup on a throwaway universe: compile #1 (kinit
-            # layouts) + compile #2 (kernel-chained layouts).
-            t0 = time.perf_counter()
-            wl, wg = kinit(sim.init(cfg, n_groups=n_groups))
-            wl = kstep(wl, 0, CHUNK)
-            pkernel.kelections(wl, wg)
-            wl = kstep(wl, CHUNK, CHUNK)
-            pkernel.kelections(wl, wg)
-            k_warmup_s = time.perf_counter() - t0
-            log(f"  [pallas] warmup (incl. 2 compiles): {k_warmup_s:.1f}s")
-            leaves, g = kinit(sim.init(cfg, n_groups=n_groups))
-            start = time.perf_counter()
-            at = 0
-            while at < ticks:
-                n = min(CHUNK, ticks - at)
-                leaves = kstep(leaves, at, n)
-                at += n
-            pkernel.kelections(leaves, g)   # fetch closes the timer
-            k_elapsed = time.perf_counter() - start
-            st_pal, m_pal = pkernel.kfinish(cfg, leaves, g)
-            f_pal = pkernel.kflight(cfg, leaves, g)
-            state_ok, s_why = _trees_equal_why(st, st_pal)
-            metrics_ok, m_why = _trees_equal_why(m, m_pal)
-            flight_ok, f_why = _trees_equal_why(f, f_pal)
-            log(f"  [pallas{'' if nd == 1 else f' x{nd}dev'}] {label} "
-                f"{n_groups} groups x {ticks} ticks in "
-                f"{k_elapsed:.2f}s ({k_elapsed / ticks * 1e3:.2f} ms/tick)")
-            if state_ok and metrics_ok and flight_ok:
-                log("  [pallas] differential vs xla at same tick: full "
-                    "State + full Metrics (incl. histogram + safety) + "
-                    "flight ring bit-identical")
-                engine, elapsed = k_name, k_elapsed
-            else:
-                log(f"  [pallas] DIFFERENTIAL MISMATCH (state_identical="
-                    f"{state_ok} metrics_identical={metrics_ok} "
-                    f"flight_identical={flight_ok}) - "
-                    f"kernel number discarded")
-                for why in (s_why, m_why, f_why):
-                    if why:
-                        log(f"  [pallas] {why}")
-                dump_flight(f, label=f"{label}:xla-ref")
-                dump_flight(f_pal, label=f"{label}:pallas")
-                engine = "xla-scan (pallas mismatch!)"
-    except Exception as e:
-        log(f"  [pallas] failed ({type(e).__name__}: {e}); xla stands")
-        engine = f"xla-scan (pallas error: {type(e).__name__})"
+    pal = _pallas_full_run(cfg, n_groups, ticks, "kelections", label,
+                           st, m, f)
+    engine, k_elapsed, k_warmup_s = (pal["engine"], pal["k_elapsed"],
+                                     pal["k_warmup_s"])
+    state_ok, metrics_ok, flight_ok = (pal["state_ok"], pal["metrics_ok"],
+                                       pal["flight_ok"])
+    nd, k_name = pal["nd"], pal["k_name"]
+    elapsed = k_elapsed if pal["promoted"] else x_elapsed
 
     unsafe = _safety_check(label, m, f, n_groups)
     p50 = latency_quantile(m.hist, 0.5)
@@ -578,6 +611,104 @@ def bench_reads(n_groups: int, ticks: int):
     return seg
 
 
+def bench_clients(seed: int, n_groups: int, ticks: int, label: str):
+    """Client-SLO segment on BOTH engines (DESIGN.md §10): the config-5
+    fault mix with open-loop exactly-once session traffic replacing the
+    scheduled fire-hose. What every other segment measures in
+    protocol-internal rounds/s, this one measures as what a CLIENT
+    sees: committed-exactly-once ops/s and the ack-latency
+    distribution (submit -> durable-apply witness, in ticks), under
+    leader crashes that force ambiguous-failure retries — every retry
+    a potential duplicate log entry the dedup fold must apply once.
+
+    Same from-tick-0 protocol as bench_fault_latency (the latency
+    histogram needs every tick; throwaway-universe warmups absorb both
+    engines' compiles; warmup and timed walls are SEPARATE fields).
+    The kernel number is promoted only under the full-State
+    `state_identical` gate — which now spans the session-table and
+    client-state leaves — plus full Metrics (client lanes included)
+    and the flight ring; the kernel self-skips off-TPU. The
+    exactly-once verdict is asserted per segment: the per-tick safety
+    fold (which latches check.client_safety every tick) AND the
+    endpoint accounting report must both be clean."""
+    cfg = RaftConfig(seed=seed, sessions=True, cmds_per_tick=0,
+                     client_rate=0.2, client_slots=4,
+                     client_retry_backoff=8,
+                     crash_prob=0.3, crash_epoch=64,
+                     partition_prob=0.2, partition_epoch=64, drop_prob=0.02)
+    t0 = time.perf_counter()
+    wst, _, _ = run_recorded(cfg, sim.init(cfg, n_groups=n_groups),
+                             CHUNK, 0,
+                             metrics_init(n_groups, clients=True),
+                             flight_init(n_groups))
+    jax.block_until_ready(wst)
+    x_warmup_s = time.perf_counter() - t0
+    log(f"  [xla] warmup chunk (incl. compile): {x_warmup_s:.1f}s")
+    st = sim.init(cfg, n_groups=n_groups)
+    m = metrics_init(n_groups, clients=True)
+    f = flight_init(n_groups)
+    start = time.perf_counter()
+    for tick_at in range(0, ticks, CHUNK):
+        st, m, f = run_recorded(cfg, st, min(CHUNK, ticks - tick_at),
+                                tick_at, m, f)
+    acked = total_client_ops(m)             # fetch closes the timer
+    x_elapsed = time.perf_counter() - start
+    retries = total_client_retries(m)
+    log(f"  [xla] {label} {n_groups} groups x {ticks} ticks in "
+        f"{x_elapsed:.2f}s ({x_elapsed / ticks * 1e3:.2f} ms/tick): "
+        f"{acked} client ops acked, {retries} retries")
+
+    pal = _pallas_full_run(cfg, n_groups, ticks, "kacked", label,
+                           st, m, f)
+    engine, k_elapsed, k_warmup_s = (pal["engine"], pal["k_elapsed"],
+                                     pal["k_warmup_s"])
+    state_ok, metrics_ok, flight_ok = (pal["state_ok"], pal["metrics_ok"],
+                                       pal["flight_ok"])
+    nd, k_name = pal["nd"], pal["k_name"]
+    elapsed = k_elapsed if pal["promoted"] else x_elapsed
+
+    unsafe = _safety_check(label, m, f, n_groups)
+    eo_ok, eo_why = exactly_once_report(cfg, st, m)
+    exactly_once = eo_ok and unsafe == 0
+    log(f"  [{label}] exactly-once verdict: "
+        f"{'PROVEN clean' if exactly_once else 'VIOLATED'} — {eo_why}; "
+        f"{retries} duplicate-risk retries under the fault mix")
+    p50 = latency_quantile(m.client_hist, 0.5)
+    p99 = latency_quantile(m.client_hist, 0.99)
+    censored = latency_censored(m.client_hist, 0.99)
+    log(f"  {label}: {acked} acked ops ({acked / elapsed:,.0f} ops/s), "
+        f"ack latency p50={p50} p99={p99} "
+        f"max={int(m.client_max_lat)} ticks"
+        f"{' [p99 CENSORED at histogram top bucket]' if censored else ''}"
+        f"; engine={engine}")
+    seg = {
+        "client_ops_per_sec": round(acked / elapsed, 1),
+        "acked_ops": acked, "retries": retries,
+        "ack_p50_ticks": p50, "ack_p99_ticks": p99,
+        "ack_p99_censored": censored,
+        "ack_max_ticks": int(m.client_max_lat),
+        "exactly_once_ok": exactly_once,
+        "engine": engine,
+        "state_identical": state_ok, "metrics_identical": metrics_ok,
+        "flight_identical": flight_ok,
+        "n_groups": n_groups, "ticks": ticks,
+        "timed_wall_s": round(elapsed, 3),
+        "xla_wall_s": round(x_elapsed, 3),
+        "xla_warmup_wall_s": round(x_warmup_s, 3),
+        "kernel_wall_s": (round(k_elapsed, 3)
+                          if k_elapsed is not None else None),
+        "kernel_warmup_wall_s": (round(k_warmup_s, 3)
+                                 if k_warmup_s is not None else None),
+        "safety_ok": unsafe == 0, "unsafe_groups": unsafe,
+        # Workload provenance (ISSUE r09): every client segment's
+        # manifest records the open-loop parameters it measured.
+        "workload": workload_params(cfg),
+        **_mesh_fields(n_groups, nd if engine == k_name else 1),
+    }
+    emit_manifest(label, cfg, device=_device_str(), **seg)
+    return seg
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -595,6 +726,7 @@ def main():
         f_groups, f_ticks = 1_000, 200
         r_groups, r_ticks = 1_000, 200
         rd_groups, rd_ticks = 1_000, 200
+        cl_groups, cl_ticks = 1_000, 200
     else:
         # The headline runs at the true config-5 shape: 100K groups.
         # (History: a TPU kernel fault at 100K groups blocked this shape
@@ -608,6 +740,7 @@ def main():
         # sub-second (the rate is schedule-bound; see the fn docstring).
         r_groups, r_ticks = 10_000, 2400
         rd_groups, rd_ticks = 50_000, 600   # ReadIndex-at-scale segment
+        cl_groups, cl_ticks = 50_000, 600   # client-SLO-at-scale segment
 
     log(f"throughput (config-5 shape, {groups} x 5-node groups):")
     tp = bench_throughput(groups, ticks)
@@ -619,8 +752,16 @@ def main():
     c2 = bench_election_rounds(r_groups, r_ticks)
     log("linearizable reads (config-5 shape + ReadIndex schedule):")
     rd = bench_reads(rd_groups, rd_ticks)
+    log("client traffic SLO (config-5 fault mix + open-loop exactly-once "
+        "sessions, both engines):")
+    cl = bench_clients(47, cl_groups, cl_ticks, "client-slo fault mix")
 
-    safety_ok = all(s["safety_ok"] for s in (tp, c4, c5f, c2, rd))
+    # The client segment's per-segment exactly-once verdict (per-tick
+    # fold AND endpoint accounting) folds into the global safety bit:
+    # a double-apply must trip the same top-level flag automation
+    # watches, not only a buried per-segment field.
+    safety_ok = all(s["safety_ok"] for s in (tp, c4, c5f, c2, rd, cl)) \
+        and cl["exactly_once_ok"]
     if not safety_ok:
         log("SAFETY: at least one segment dropped the per-tick safety "
             "bit — see the flight-recorder dumps above")
@@ -681,6 +822,20 @@ def main():
         "reads_engine": rd["engine"],
         "reads_state_identical": rd["state_identical"],
         "reads_safety_ok": rd["safety_ok"],
+        # Client-visible SLO (DESIGN.md §10): committed-exactly-once
+        # ops/s + ack latency under the config-5 fault mix, next to the
+        # protocol-internal rounds/s above.
+        "client_ops_per_sec": cl["client_ops_per_sec"],
+        "client_ops_acked": cl["acked_ops"],
+        "client_retries": cl["retries"],
+        "client_ack_p50_ticks": cl["ack_p50_ticks"],
+        "client_ack_p99_ticks": cl["ack_p99_ticks"],
+        "client_ack_p99_censored": cl["ack_p99_censored"],
+        "client_exactly_once_ok": cl["exactly_once_ok"],
+        "client_engine": cl["engine"],
+        "client_state_identical": cl["state_identical"],
+        "client_safety_ok": cl["safety_ok"],
+        "client_workload": cl["workload"],
         "device": f"{dev.platform}:{dev.device_kind}",
     }))
 

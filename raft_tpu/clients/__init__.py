@@ -1,0 +1,24 @@
+"""Client traffic subsystem (DESIGN.md §10): deterministic open-loop
+exactly-once sessions on BOTH engines.
+
+`state.py` carries the per-(group, sid) client state that rides
+`State.clients`; `workload.py` is the one elementwise transition both
+engines evaluate (plus its pure-Python oracle mirror `HostClients` and
+the endpoint `exactly_once_report` gate). The replicated `(sid, seq)`
+dedup tables live in the protocol state (`sim/state.py
+PerNode.session_seq`); the per-tick exactly-once invariant in
+`sim/check.py client_safety`; the client-visible SLO lanes in
+`sim/run.py Metrics` / `sim/pkernel.py KMetrics`.
+"""
+
+from raft_tpu.clients.state import CLIENT_LEAVES, ClientState, clients_init
+from raft_tpu.clients.workload import (HostClients, client_update,
+                                       clients_64_cfg, exactly_once_report,
+                                       submit_payloads, table_max,
+                                       workload_params)
+
+__all__ = [
+    "CLIENT_LEAVES", "ClientState", "HostClients", "client_update",
+    "clients_64_cfg", "clients_init", "exactly_once_report",
+    "submit_payloads", "table_max", "workload_params",
+]

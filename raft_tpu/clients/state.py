@@ -1,0 +1,50 @@
+"""Per-group open-loop client state for the scheduled traffic model
+(DESIGN.md §10).
+
+Every leaf is i32 with leading dims `[G, S]` (S = cfg.client_slots) on
+the batched XLA path and `[S, 8, 128]` tiles on the Pallas kernel wire
+— the transition in `clients/workload.py` is written purely
+elementwise so ONE implementation serves both layouts, exactly like
+utils/jrng serves both engines.
+
+This is CLIENT-side (environment) state, not replicated state: it
+rides `State.clients` so the scan carry / kernel wire / checkpoints
+all transport it, but no node ever reads another group's client state
+and the protocol tick only sees it through phase C's submit pulses.
+The replicated dedup table lives in `PerNode.session_seq`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+# Wire/leaf order of the client state — `ClientState._fields` IS the
+# contract (scripts/check_metric_parity.py pins dtype/shape).
+CLIENT_LEAVES = ("done", "backlog", "inflight", "t_start", "t_sub",
+                 "submit", "retries", "last_lat")
+
+
+class ClientState(NamedTuple):
+    """One open-loop exactly-once client per (group, sid) slot."""
+
+    done: jnp.ndarray      # ops fully acked == seq of the NEXT op
+    backlog: jnp.ndarray   # arrived-but-not-started ops (open-loop queue)
+    inflight: jnp.ndarray  # 0/1: an op (seq == done) is being processed
+    t_start: jnp.ndarray   # tick the in-flight op was first submitted
+    t_sub: jnp.ndarray     # tick of the LAST submission (retry clock)
+    submit: jnp.ndarray    # 0/1 pulse: leaders append this op next tick
+    retries: jnp.ndarray   # re-submissions to date (potential duplicates)
+    last_lat: jnp.ndarray  # ack latency of an op acked THIS tick; -1 none
+
+
+def clients_init(cfg, n_groups: int) -> ClientState:
+    """Fresh clients: idle, empty backlogs, no events."""
+    z = jnp.zeros((n_groups, cfg.client_slots), I32)
+    return ClientState(done=z, backlog=z, inflight=z, t_start=z, t_sub=z,
+                       submit=z, retries=z,
+                       last_lat=jnp.full((n_groups, cfg.client_slots),
+                                         -1, I32))

@@ -1,0 +1,259 @@
+"""Deterministic open-loop client workload (DESIGN.md §10).
+
+Traffic model, per (group, sid) slot:
+
+- **Arrival** (open loop): a new op arrives w.p. `cfg.client_rate` each
+  tick (Bernoulli per tick — the discrete-tick Poisson limit), hashed
+  from `(seed, TAG_CLIENT_ARRIVAL, g, sid, t)` like every other
+  schedule, and joins the slot's backlog. Arrivals never wait for acks
+  — the generator keeps offering load while the group is leaderless,
+  which is what makes the measurement open-loop.
+- **Submission**: an idle client with backlog starts its next op
+  (seq = `done`) and raises a one-tick `submit` pulse; EVERY node that
+  believes itself leader appends the op in the NEXT tick's phase C
+  (a real client broadcasts to whoever claims leadership — two
+  transient leaders produce duplicate log entries, which is exactly
+  what the dedup table is for).
+- **Ack**: the op is client-visibly committed once ANY node's applied
+  dedup table holds `seq >= done` — table entries only advance at
+  apply time (applied <= commit), so a table witness IS a durable
+  commit witness. Ack latency = `t_ack - t_start` (service latency;
+  backlog depth is reported separately — queueing delay of ops still
+  in the backlog is deliberately not folded into the histogram).
+- **Retry with backoff** (the ambiguous-failure path): no ack within
+  `cfg.client_retry_backoff` ticks of the last submission → re-submit
+  the SAME `(sid, seq, val)` payload (`client_val` hashes the op
+  identity, so the retry is byte-identical). A leader crash between
+  append and ack makes the outcome ambiguous; the retry may commit a
+  duplicate entry, and the exactly-once fold applies it once.
+
+Sequence-space bound: seq is the 10-bit session field, so arrivals are
+gated on `done + backlog + inflight <= SESSION_SEQ_MASK` — a slot
+saturates at 1024 lifetime ops (config.py's documented session
+lifetime) instead of wrapping, which would alias the dedup filter.
+
+One transition, two engines, one oracle: `client_update` /
+`submit_payloads` are written purely elementwise so the SAME jnp code
+runs on `[G, S]` leaves (sim/step.py) and `[S, 8, 128]` kernel tiles
+(sim/pkernel.py); `HostClients` is the pure-Python mirror driving the
+CPU oracle `Cluster`, bit-identical by the shared utils/rng hashes
+(pinned by tests/test_clients.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import config as _c
+from raft_tpu.config import RaftConfig
+from raft_tpu.clients.state import CLIENT_LEAVES, ClientState, clients_init
+from raft_tpu.utils import jrng, rng
+
+__all__ = ["CLIENT_LEAVES", "ClientState", "clients_init", "client_update",
+           "submit_payloads", "HostClients", "table_max",
+           "exactly_once_report", "clients_64_cfg", "workload_params"]
+
+I32 = jnp.int32
+
+
+def clients_64_cfg() -> RaftConfig:
+    """THE shared client-differential universe: 64 faulted k=3/L=8
+    groups (kmesh.faulted_64_cfg's fault mix) carrying 3 retrying
+    open-loop sessions per group. tests/test_clients.py's oracle
+    differential, its kernel bit-parity test, and the checkpoint
+    round-trip all simulate exactly this config so the clients-on tick
+    compiles ONCE per machine (tests/conftest.py compile-cache
+    recipe)."""
+    return RaftConfig(n_groups=64, k=3, seed=29, log_cap=8, compact_every=4,
+                      sessions=True, cmds_per_tick=0,
+                      client_rate=0.3, client_slots=3,
+                      client_retry_backoff=5,
+                      drop_prob=0.05, crash_prob=0.2, crash_epoch=16,
+                      partition_prob=0.2, partition_epoch=16)
+
+
+def workload_params(cfg: RaftConfig) -> dict:
+    """The client-workload provenance block every bench manifest and
+    client segment records (ISSUE r09: a client-SLO number without its
+    workload parameters is not reproducible)."""
+    return {"rate": cfg.client_rate, "slots": cfg.client_slots,
+            "retry_backoff": cfg.client_retry_backoff,
+            "retry_policy": "fixed-interval-resubmit",
+            "seed": cfg.seed}
+
+
+def table_max(session_seq, node_axis: int):
+    """Group-level durable-commit witness: the max applied seq per sid
+    over the group's nodes. `node_axis` is the K axis of the layout
+    ([G, K, S] batched -> 1; [K, S, 8, 128] kernel -> 0)."""
+    return jnp.max(session_seq, axis=node_axis)
+
+
+def client_update(cfg: RaftConfig, cs: ClientState, tmax, g, sid, t
+                  ) -> ClientState:
+    """One client transition, evaluated on the POST-tick state. Purely
+    elementwise over broadcastable coordinate grids `g`/`sid` and the
+    per-slot table witness `tmax` — layout-agnostic (module docstring).
+    `HostClients._update` mirrors this line for line."""
+    acked = (cs.inflight != 0) & (tmax >= cs.done)
+    last_lat = jnp.where(acked, t - cs.t_start, -1)
+    done = cs.done + acked.astype(I32)
+    inflight = jnp.where(acked, 0, cs.inflight)
+    # Open-loop arrival, gated on the 10-bit lifetime bound.
+    room = (done + cs.backlog + inflight) <= _c.SESSION_SEQ_MASK
+    arrive = jrng.client_arrives(cfg.seed, g, sid, t, cfg.clients_u32) & room
+    backlog = cs.backlog + arrive.astype(I32)
+    # Retry BEFORE start: only an op that stayed in flight re-submits.
+    retry = (inflight != 0) & ((t - cs.t_sub) >= cfg.client_retry_backoff)
+    start = (inflight == 0) & (backlog > 0)
+    submit = (start | retry).astype(I32)
+    return ClientState(
+        done=done,
+        backlog=backlog - start.astype(I32),
+        inflight=jnp.where(start, 1, inflight),
+        t_start=jnp.where(start, t, cs.t_start),
+        t_sub=jnp.where(start | retry, t, cs.t_sub),
+        submit=submit,
+        retries=cs.retries + retry.astype(I32),
+        last_lat=last_lat,
+    )
+
+
+def submit_payloads(cfg: RaftConfig, cs: ClientState, g, sid):
+    """(submit, payload): the one-tick pulses phase C consumes and the
+    full 30-bit session payloads they carry (seq = the slot's `done`,
+    val hashed from the op identity so retries are byte-identical).
+    Elementwise like `client_update` — both engines call it."""
+    val = jrng.client_val(cfg.seed, g, sid, cs.done)
+    payload = (jnp.int32(_c.SESSION_FLAG)
+               | (sid << _c.SESSION_SID_SHIFT)
+               | (cs.done << _c.SESSION_SEQ_SHIFT) | val)
+    return cs.submit, payload   # i32 pulses: kernel-safe (no i1 vectors)
+
+
+# ----------------------------------------------------------- CPU oracle side
+
+
+class HostClients:
+    """Pure-Python mirror of `client_update`/`submit_payloads` for ONE
+    group, driving the CPU oracle `Cluster` (core/cluster.py wires it
+    in when cfg.client_rate > 0). Every branch matches the jnp
+    transition term for term; the differential in tests/test_clients.py
+    holds the two bit-identical through the full retrying schedule."""
+
+    def __init__(self, cfg: RaftConfig, group: int):
+        self.cfg = cfg
+        self.g = group
+        s = cfg.client_slots
+        self.done = [0] * s
+        self.backlog = [0] * s
+        self.inflight = [0] * s
+        self.t_start = [0] * s
+        self.t_sub = [0] * s
+        self.submit = [0] * s
+        self.retries = [0] * s
+        self.last_lat = [-1] * s
+        # Host-side SLO tally (the oracle's analogue of the client
+        # metric lanes): completed-op ack latencies, in ticks.
+        self.latencies: list[int] = []
+
+    def pending_cmds(self) -> list[int]:
+        """The payloads phase C appends THIS tick, in slot order — the
+        pulses raised by the previous tick's `observe`."""
+        out = []
+        for s in range(self.cfg.client_slots):
+            if self.submit[s]:
+                out.append(_c.session_payload(
+                    s, self.done[s],
+                    rng.client_val(self.cfg.seed, self.g, s, self.done[s])))
+        return out
+
+    def observe(self, tmax: list[int], t: int) -> None:
+        """`client_update` on host ints: fold the post-tick table
+        witness `tmax` (max applied seq per sid over the group's
+        nodes) and raise next tick's pulses."""
+        cfg = self.cfg
+        for s in range(cfg.client_slots):
+            acked = bool(self.inflight[s]) and tmax[s] >= self.done[s]
+            self.last_lat[s] = t - self.t_start[s] if acked else -1
+            if acked:
+                self.latencies.append(t - self.t_start[s])
+                self.done[s] += 1
+                self.inflight[s] = 0
+            room = (self.done[s] + self.backlog[s] + self.inflight[s]
+                    <= _c.SESSION_SEQ_MASK)
+            if room and rng.client_arrives(cfg.seed, self.g, s, t,
+                                           cfg.clients_u32):
+                self.backlog[s] += 1
+            retry = (self.inflight[s]
+                     and t - self.t_sub[s] >= cfg.client_retry_backoff)
+            start = not self.inflight[s] and self.backlog[s] > 0
+            if start:
+                self.backlog[s] -= 1
+                self.inflight[s] = 1
+                self.t_start[s] = t
+            if start or retry:
+                self.t_sub[s] = t
+            self.submit[s] = 1 if (start or retry) else 0
+            if retry:
+                self.retries[s] += 1
+
+
+# --------------------------------------------------------- exactly-once gate
+
+
+def exactly_once_report(cfg: RaftConfig, st, metrics=None):
+    """(ok, detail): host-side exactly-once accounting over a FINAL
+    state — the endpoint complement of the per-tick client-safety fold
+    (sim/check.py `client_safety`). Checks, per group:
+
+    - dedup-table agreement: nodes with the same applied prefix hold
+      identical (sid -> seq) tables (a divergent dedup DECISION);
+    - no phantom apply: no node's table holds a seq above the slot's
+      issued frontier (`done`);
+    - every fully-applied node agrees: nodes whose applied index
+      reaches the group max hold the group-max table per sid (the
+      crash-stable form of "every ack is table-backed" — a
+      mid-recovery node legitimately lags, a caught-up one cannot);
+    - metric accounting (when `metrics` carries client lanes):
+      `client_acked[g] == sum_s done[g, s]` exactly.
+    """
+    nodes = st.nodes
+    cl = st.clients
+    if cl is None or nodes.session_seq is None:
+        return False, "state carries no client subsystem"
+    table = np.asarray(nodes.session_seq)          # [G, K, S]
+    applied = np.asarray(nodes.applied)            # [G, K]
+    done = np.asarray(cl.done)                     # [G, S]
+    g, k, s = table.shape
+    problems = []
+    for a in range(k):
+        for b in range(a + 1, k):
+            bad = (applied[:, a] == applied[:, b]) \
+                & (table[:, a] != table[:, b]).any(axis=-1)
+            if bad.any():
+                problems.append(
+                    f"nodes {a}/{b}: {int(bad.sum())} group(s) with equal "
+                    f"applied prefix but divergent dedup tables")
+    over = table > done[:, None, :]
+    if over.any():
+        problems.append(f"{int(over.any(axis=(1, 2)).sum())} group(s) hold "
+                        f"a table seq above the issued frontier")
+    # Tables are monotone in the applied prefix, so the most-applied
+    # node must hold the group's pointwise-max table.
+    top = np.take_along_axis(
+        table, applied.argmax(axis=1)[:, None, None], axis=1)[:, 0, :]
+    lag = top < table.max(axis=1)
+    if lag.any():
+        problems.append(f"{int(lag.any(axis=1).sum())} group(s): a node "
+                        f"with a shorter applied prefix holds a HIGHER "
+                        f"dedup seq than the most-applied node")
+    if metrics is not None and metrics.client_acked is not None:
+        acked = np.asarray(metrics.client_acked)
+        if not np.array_equal(acked, done.sum(axis=1)):
+            problems.append("client_acked metric != sum of per-slot done")
+    return (not problems,
+            "; ".join(problems) if problems else
+            f"exactly-once ok over {g} group(s) x {s} slot(s): "
+            f"{int(done.sum())} acked op(s), tables consistent")

@@ -86,14 +86,31 @@ class RaftConfig:
     compact_every: int = 8     # snapshot when commit - snap_index >= this
     cmds_per_tick: int = 1     # client commands the leader appends per tick
     # Client sessions (exactly-once application, dissertation §6.3) —
-    # CPU-oracle client feature; the session bit-fields above become
-    # meaningful to the state machine only when True. Interactive
-    # `propose` payloads must then keep bit 29 clear (asserted); the
-    # scheduled batched workload hashes the full 30-bit space, so
-    # sessions=True is for interactive-client universes
-    # (cmds_per_tick=0), not scheduled ones.
+    # the session bit-fields above become meaningful to the state
+    # machine only when True. Interactive `propose` payloads must then
+    # keep bit 29 clear (asserted); scheduled fire-hose payloads hash
+    # the full 30-bit space, so sessions=True requires cmds_per_tick=0.
+    # Two client modes ride this flag: interactive oracle clients
+    # (Cluster.propose_seq / open_session) and, when client_rate > 0,
+    # the scheduled open-loop traffic below — on BOTH engines.
     sessions: bool = False
     seed: int = 0
+
+    # Scheduled client traffic (open-loop, exactly-once — DESIGN.md
+    # §10). When client_rate > 0 every group carries `client_slots`
+    # pre-registered sessions (sid 0..client_slots-1); each session is
+    # an independent open-loop client whose ops arrive w.p. client_rate
+    # per tick (Bernoulli — the discrete-tick Poisson limit), queue in
+    # a backlog, and are submitted to whichever node(s) claim
+    # leadership. A client that sees no ack within
+    # client_retry_backoff ticks RE-SUBMITS the same (sid, seq) — the
+    # ambiguous-failure retry after a leader crash — and the per-group
+    # (sid, seq) dedup table in the replicated state machine folds the
+    # duplicate exactly once. Requires sessions=True (the state machine
+    # must interpret bit 29) and hence cmds_per_tick=0.
+    client_rate: float = 0.0
+    client_slots: int = 4
+    client_retry_backoff: int = 8
 
     # Fault injection (DESIGN.md §4). All off by default.
     drop_prob: float = 0.0       # per-link per-tick message loss
@@ -143,6 +160,24 @@ class RaftConfig:
             "sessions=True needs cmds_per_tick=0: scheduled payloads hash "
             "the full 30-bit space, so bit 29 would be misread as session "
             "commands (see the sessions field comment)")
+        if self.client_rate > 0.0:
+            assert self.sessions, (
+                "client_rate > 0 needs sessions=True: scheduled client "
+                "traffic is session commands, and the state machine only "
+                "interprets bit 29 under the sessions flag")
+            # The subsystem gates on the QUANTIZED threshold everywhere;
+            # a rate below 2^-32 would pass the float test yet build a
+            # clients-off universe — reject it here, loudly.
+            assert self.clients_u32 > 0, (
+                f"client_rate {self.client_rate} quantizes to a zero "
+                f"uint32 arrival threshold (< 2**-32): the client "
+                f"subsystem would be statically absent")
+            # sid 0..client_slots-1 must stay clear of the reserved
+            # REGISTER marker, and both engines statically unroll the
+            # slot axis — keep it register-sized.
+            assert 1 <= self.client_slots <= 16, (
+                "client_slots must be in [1, 16]")
+            assert self.client_retry_backoff >= 1
         assert self.k >= 1
         assert self.election_range >= 1
         assert self.heartbeat_every >= 1
@@ -177,6 +212,13 @@ class RaftConfig:
     @property
     def effective_min_voters(self) -> int:
         return self.min_voters if self.min_voters > 0 else self.k // 2 + 1
+
+    @property
+    def clients_u32(self) -> int:
+        """uint32 arrival threshold of the scheduled client traffic —
+        the ONE static gate for the whole subsystem on both engines
+        (0 = every client structure is absent from the programs)."""
+        return _prob_to_u32(self.client_rate)
 
     @property
     def reconfig_u32(self) -> int:

@@ -66,6 +66,14 @@ class Cluster:
         # collision without peeking at server state mid-protocol.
         self._session_owner: Dict[int, int] = {}
         self.total_applies = 0
+        # Scheduled open-loop client traffic (DESIGN.md §10): the
+        # host-side mirror of the batched client transition — pulses
+        # feed phase C, the post-tick dedup-table witness feeds back.
+        if cfg.clients_u32:
+            from raft_tpu.clients.workload import HostClients
+            self.clients = HostClients(cfg, group)
+        else:
+            self.clients = None
 
     # ---------------------------------------------------------------- faults
 
@@ -119,6 +127,11 @@ class Cluster:
             if alive_now[i] and not self.alive_prev[i]:
                 n.restart()
         inboxes = self.transport.deliver(t, alive_now)
+        # Pulses raised by the previous tick's client transition — read
+        # BEFORE the phases (the batched path snapshots them the same
+        # way: submit_payloads on the start-of-tick state).
+        client_cmds = (self.clients.pending_cmds()
+                       if self.clients is not None else None)
         for i, n in enumerate(self.nodes):
             if alive_now[i]:
                 n.phase_d(inboxes[i])
@@ -127,12 +140,20 @@ class Cluster:
                 n.phase_t()
         for i, n in enumerate(self.nodes):
             if alive_now[i]:
-                n.phase_c()
+                n.phase_c(client_cmds)
         for i, n in enumerate(self.nodes):
             if alive_now[i]:
                 n.phase_a()
         # Crashed nodes sent nothing; anything they had queued pre-crash was
         # already in flight and still delivers.
+        if self.clients is not None:
+            # Post-tick client transition: the durable-commit witness is
+            # the max applied seq per sid over ALL nodes (a crashed
+            # node's frozen table still witnesses committed applies),
+            # exactly the batched table_max.
+            self.clients.observe(
+                [max(n.sessions.get(s, -1) for n in self.nodes)
+                 for s in range(self.cfg.client_slots)], t)
         if self.check:
             self._check_election_safety()
         self.alive_prev = alive_now

@@ -49,7 +49,12 @@ class Node:
         self.snap_voters = cfg.full_mask  # voter mask as of the snapshot prefix
         # Session table as of the snapshot prefix (cfg.sessions only):
         # sid -> last applied client seq. Durable with the snapshot.
-        self.snap_sessions: dict = {}
+        # Scheduled client traffic (cfg.client_rate > 0, DESIGN.md §10)
+        # pre-registers slots 0..client_slots-1 with no applied
+        # commands — bit-matching the batched path's session_seq init.
+        self.snap_sessions: dict = (
+            {s: -1 for s in range(cfg.client_slots)}
+            if cfg.clients_u32 else {})
         self.rng_draws = 0           # monotone deadline-draw counter
 
         # Volatile state (reset on restart).
@@ -780,11 +785,21 @@ class Node:
             return
         self.sched_read = (self.commit, self.now)
 
-    def phase_c(self):
+    def phase_c(self, client_cmds=None):
+        """`client_cmds`: the scheduled open-loop clients' pulsed
+        session payloads for this tick (DESIGN.md §10), in slot order —
+        every node that believes itself leader appends them (duplicate
+        appends by transient dual leaders are exactly what the
+        exactly-once fold dedups), stopping at window-full like the
+        batched path's stopped latch."""
         if self.role != LEADER:
             return
         self._maybe_schedule_read()
         self._maybe_propose_reconfig()
+        if client_cmds:
+            for payload in client_cmds:
+                if not self._append(self.term, payload):
+                    break
         for _ in range(self.cfg.cmds_per_tick):
             payload = rng.client_payload(
                 self.cfg.seed, self.g, self.term, self.last_index + 1)

@@ -118,7 +118,8 @@ def run_recorded(cfg: RaftConfig, st: State, n_ticks: int, t0=0,
     feeds back. Chunked drivers pass the returned metrics/flight back
     in to continue the same recording."""
     if metrics is None:
-        metrics = metrics_init(st.alive_prev.shape[0])
+        metrics = metrics_init(st.alive_prev.shape[0],
+                               clients=st.clients is not None)
     if flight is None:
         flight = flight_init(st.alive_prev.shape[0])
 

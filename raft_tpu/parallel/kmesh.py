@@ -159,7 +159,7 @@ def kglobal_sharded(cfg: RaftConfig, leaves, g: int, mesh: Mesh
     reassociate). Module-level jit (like `_kstep_sharded`): repeated
     calls at one (g, mesh, shape) reuse a single compiled reduction."""
     gid = leaves[pkernel._n_state_leaves(cfg) - 1]
-    tail = [pkernel._mleaf(leaves, n)
+    tail = [pkernel._mleaf(cfg, leaves, n)
             for n in ("committed", "elections", "hist", "max_latency",
                       "safety")]
     return _kglobal_sharded(mesh, int(g), gid, *tail)

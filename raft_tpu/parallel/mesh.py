@@ -115,7 +115,8 @@ def run_sharded(cfg: RaftConfig, st: State, n_ticks: int, mesh: Mesh,
         # out of the scan body vary per shard; mark them varying up front
         # or the scan carry types mismatch under shard_map.
         m0 = jax.tree.map(lambda a: _pvary(a, AXIS),
-                          metrics_init(st_local.alive_prev.shape[0]))
+                          metrics_init(st_local.alive_prev.shape[0],
+                                       clients=st_local.clients is not None))
         s, m = run(cfg, st_local, n_ticks, t0, m0)
         return s, GlobalMetrics(
             rounds=jax.lax.psum(jnp.sum(m.committed), AXIS),

@@ -62,15 +62,52 @@ def window_bounds(st: State, log_cap: int):
     return jnp.all(ok, axis=1)
 
 
+def client_safety(st: State):
+    """bool[G]: the exactly-once invariant (DESIGN.md §10), checked
+    every tick when the scheduled client traffic is on. Two clauses:
+
+    - dedup-decision agreement: nodes with the SAME applied prefix hold
+      element-identical (sid -> seq) tables — a divergent dedup
+      decision (one node skipping a duplicate another folded) trips
+      this even if the digests happen to collide;
+    - no phantom apply: no node's table entry exceeds the slot's issued
+      frontier (`clients.done` — the client never issued a higher seq,
+      and dedup-table entries only ever come from applied commands).
+
+    A duplicate retry that double-applied would desynchronize either
+    the tables (clause 1) or the digest chain (digest_agreement); the
+    pair is what lets the bench assert "a duplicate never
+    double-applies" per segment instead of per run. (A table LOWER
+    bound is deliberately absent: restart rewinds a node's live table
+    to its snapshot table until re-apply catches up, so "every ack has
+    a current table witness" is not crash-stable — the ack-time
+    witness requirement lives in the client transition itself and in
+    the oracle differential, tests/test_clients.py.)"""
+    nodes = st.nodes
+    cl = st.clients
+    k = nodes.term.shape[1]
+    table = nodes.session_seq                       # [G, K, S]
+    ok = jnp.all(table <= cl.done[:, None, :], axis=(1, 2))
+    for a, b in itertools.combinations(range(k), 2):
+        clash = ((nodes.applied[:, a] == nodes.applied[:, b])
+                 & jnp.any(table[:, a] != table[:, b], axis=-1))
+        ok &= ~clash
+    return ok
+
+
 def all_invariants(st: State, log_cap: int):
-    return election_safety(st) & digest_agreement(st) & window_bounds(
+    ok = election_safety(st) & digest_agreement(st) & window_bounds(
         st, log_cap)
+    if st.clients is not None:
+        ok &= client_safety(st)
+    return ok
 
 
 def tick_safety(st: State, log_cap: int):
     """bool[G]: the per-tick safety predicate ANDed into
     `Metrics.safety` on both engines — election safety, digest
-    agreement, window bounds. A named alias of `all_invariants` so the
+    agreement, window bounds, and (with scheduled clients on) the
+    exactly-once invariant. A named alias of `all_invariants` so the
     fold's contract ("what exactly does the safety bit attest?") has
     one definition site; pkernel's `_safety_tick` must mirror any
     change here term-for-term (pinned by the kernel differentials and

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.clients.state import ClientState
 from raft_tpu.config import RaftConfig
 from raft_tpu.sim.run import Metrics
 from raft_tpu.sim.state import Mailbox, PerNode, State
@@ -30,23 +31,26 @@ from raft_tpu.sim.state import Mailbox, PerNode, State
 _VERSION = 1
 
 # Metric leaves with a leading [G] axis — these follow the State's
-# sharding on load; the scalars and the global [H] histogram replicate
+# sharding on load; the scalars and the global [H] histograms replicate
 # (discriminated by NAME, not shape: at G == HIST_SIZE a shape test
 # would shard the histogram by accident).
-_PER_GROUP_METRICS = ("committed", "leaderless", "safety")
+_PER_GROUP_METRICS = ("committed", "leaderless", "safety",
+                      "client_acked", "client_retries")
 
 
 def _shard_metrics(metrics: Metrics, sharding) -> Metrics:
     """Reshard loaded metrics like the State: per-group leaves onto the
-    mesh, the rest replicated. Only NamedShardings carry a mesh to
-    replicate over; any other placement is applied to the State alone."""
+    mesh, the rest replicated (absent client lanes stay None). Only
+    NamedShardings carry a mesh to replicate over; any other placement
+    is applied to the State alone."""
     from jax.sharding import NamedSharding, PartitionSpec
     if not isinstance(sharding, NamedSharding):
         return metrics
     rep = NamedSharding(sharding.mesh, PartitionSpec())
     return Metrics(**{
-        f: jax.device_put(getattr(metrics, f),
-                          sharding if f in _PER_GROUP_METRICS else rep)
+        f: (None if getattr(metrics, f) is None else
+            jax.device_put(getattr(metrics, f),
+                           sharding if f in _PER_GROUP_METRICS else rep))
         for f in Metrics._fields})
 
 
@@ -77,21 +81,29 @@ def save(path, st: State, t: int, metrics: Optional[Metrics] = None,
     np.savez(path, **flat)
 
 
-OPTIONAL_FIELDS = frozenset(
-    f for f in Mailbox._fields
-    if Mailbox._field_defaults.get(f, "required") is None)
+def _optional_fields(cls) -> frozenset:
+    """Fields whose NamedTuple default is None — statically-gated
+    subtrees `_flatten` legitimately skips on save: the prevote /
+    transfer / session mailbox slots, PerNode's session tables."""
+    return frozenset(f for f in cls._fields
+                     if cls._field_defaults.get(f, "required") is None)
+
+
+OPTIONAL_FIELDS = _optional_fields(Mailbox)   # kept for callers
 
 
 def _load_nt(z, prefix: str, cls):
-    """Legitimately-optional fields (the Mailbox slots whose NamedTuple
-    default is None — prevote/transfer, absent when their schedules are
-    off and skipped by `_flatten` on save) load as None; any OTHER
+    """Legitimately-optional fields (`_optional_fields` — absent when
+    their feature is off and skipped by `_flatten` on save, including
+    every pre-r09 file's session leaves) load as None; any OTHER
     missing field is a corrupt/incompatible checkpoint and raises
     immediately, naming the field."""
+    optional = _optional_fields(cls)
+
     def get(f):
         key = f"{prefix}{f}"
         if key not in z.files:
-            if f in OPTIONAL_FIELDS:
+            if f in optional:
                 return None
             raise KeyError(f"checkpoint missing field {key!r}")
         return jnp.asarray(z[key])
@@ -122,17 +134,29 @@ def load(path, cfg: Optional[RaftConfig] = None, sharding=None
         if cfg is not None and "__cfg__" in z.files:
             saved = json.loads(bytes(z["__cfg__"]).decode())
             want = json.loads(json.dumps(dataclasses.asdict(cfg)))
+            # Fields added after the file was written load as their
+            # defaults: a pre-r09 universe simply had no such feature,
+            # so the default value IS its semantic config (the same
+            # backfill rule as the r07 metrics.safety ones).
+            defaults = json.loads(json.dumps(
+                dataclasses.asdict(RaftConfig())))
+            for k, v in defaults.items():
+                saved.setdefault(k, v)
             if saved != want:
                 diff = {k: (saved.get(k), want.get(k))
                         for k in set(saved) | set(want)
                         if saved.get(k) != want.get(k)}
                 raise ValueError(f"checkpoint cfg mismatch: {diff}")
         t = int(z["__tick__"])
+        clients = None
+        if "state.clients.done" in z.files:
+            clients = _load_nt(z, "state.clients.", ClientState)
         st = State(
             nodes=_load_nt(z, "state.nodes.", PerNode),
             mailbox=_load_nt(z, "state.mailbox.", Mailbox),
             alive_prev=jnp.asarray(z["state.alive_prev"]),
             group_id=jnp.asarray(z["state.group_id"]),
+            clients=clients,
         )
         metrics = None
         if "metrics.committed" in z.files:
@@ -142,6 +166,24 @@ def load(path, cfg: Optional[RaftConfig] = None, sharding=None
                 # Pre-observability checkpoint: no per-tick safety bits
                 # were folded, so the resumed run's AND starts clean.
                 md["safety"] = jnp.ones_like(md["committed"])
+            client_lanes = ("client_acked", "client_retries",
+                            "client_hist", "client_max_lat")
+            if clients is not None:
+                # r09 backfill (same pattern as the r07 safety ones): a
+                # client universe whose file predates the SLO lanes
+                # resumes with fresh zeroed lanes — acked/retries are
+                # idempotent recomputes from the client state, so only
+                # pre-file latency history is (correctly) absent.
+                md.setdefault("client_acked",
+                              jnp.zeros_like(md["committed"]))
+                md.setdefault("client_retries",
+                              jnp.zeros_like(md["committed"]))
+                md.setdefault("client_hist", jnp.zeros_like(md["hist"]))
+                md.setdefault("client_max_lat",
+                              jnp.zeros((), md["hist"].dtype))
+            else:
+                for f in client_lanes:
+                    md.setdefault(f, None)
             missing = set(Metrics._fields) - set(md)
             if missing:
                 raise KeyError(f"checkpoint missing metric field(s) "

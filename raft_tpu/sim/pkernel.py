@@ -98,7 +98,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_tpu.config import CONFIG_FLAG, RaftConfig
+from raft_tpu.clients.state import CLIENT_LEAVES, ClientState
+from raft_tpu.clients import workload as _workload
+from raft_tpu.config import (CONFIG_FLAG, SESSION_FLAG, SESSION_SEQ_MASK,
+                             SESSION_SEQ_SHIFT, SESSION_SID_MASK,
+                             SESSION_SID_SHIFT, RaftConfig)
 from raft_tpu.core.node import (CANDIDATE, FOLLOWER, LEADER,
                                 NO_VOTE, PRECANDIDATE)
 from raft_tpu.obs.recorder import FLIGHT_LEAVES, PRESENCE_FIELDS, Flight
@@ -125,50 +129,61 @@ HBM_LIMIT_BYTES = int(_os.environ.get("RAFT_TPU_HBM_BYTES",
 
 
 def _state_words_per_group(cfg: RaftConfig) -> int:
-    """i32 words per group of the NON-ROW wire leaves: node state,
-    mailbox, alive_prev ([K, ...]: k words), group_id, and the
-    per-group metric lanes (every metric leaf except the [H]-row
-    hist). The one accumulation both byte models share — the VMEM and
-    HBM predicates drifted apart once (alive_prev counted as 1 word in
-    one copy) and tests/test_kmesh.py pins this shared form against
-    real kinit leaves."""
+    """i32 words per group of the NON-ROW wire leaves: node state
+    (incl. the two [K, S] session tables with clients on), mailbox
+    (incl. the [K, K, S] InstallSnapshot session payload), the [S]
+    client-state leaves, alive_prev ([K, ...]: k words), group_id, and
+    the per-group metric lanes (every metric leaf except the [H]-row
+    histograms). The one accumulation both byte models share — the
+    VMEM and HBM predicates drifted apart once (alive_prev counted as
+    1 word in one copy) and tests/test_kmesh.py pins this shared form
+    against real kinit leaves, clients off AND on."""
     words = 0
     for _, kind in _node_leaves(cfg):
         words += cfg.k * {"scalar": 1, "peer": cfg.k,
-                          "ring": cfg.log_cap}[kind]
-    words += len(_mb_fields(cfg)) * cfg.k * cfg.k
-    return words + cfg.k + 1 + (N_METRIC_LEAVES - 1)
+                          "ring": cfg.log_cap,
+                          "sess": cfg.client_slots}[kind]
+    for f in _mb_fields(cfg):
+        words += cfg.k * cfg.k * (cfg.client_slots
+                                  if f == "is_req_snap_sessions" else 1)
+    if cfg.clients_u32:
+        words += len(CLIENT_LEAVES) * cfg.client_slots
+    scalar_lanes = len(_active_metric_leaves(cfg)) - _n_row_metrics(cfg)
+    return words + cfg.k + 1 + scalar_lanes
 
 
 def kernel_vmem_bytes(cfg: RaftConfig) -> int:
     """Estimated peak VMEM bytes one grid step needs under `cfg`.
 
     Counts the i32 words of one 1024-group block's wire leaves (node
-    state + mailbox + alive/gid + metric tiles + histogram rows), then
-    multiplies by 5: an input and an output buffer per leaf, the
-    pipeline double-buffering both, plus roughly one block's worth held
-    live in the fori_loop carry/vregs. A coarse model — it only has to
-    reject shapes that would OOM the 100 MB budget by integer factors
-    (huge L or K), not referee marginal fits."""
+    state + mailbox + client state + alive/gid + metric tiles +
+    histogram rows), then multiplies by 5: an input and an output
+    buffer per leaf, the pipeline double-buffering both, plus roughly
+    one block's worth held live in the fori_loop carry/vregs. A coarse
+    model — it only has to reject shapes that would OOM the 100 MB
+    budget by integer factors (huge L or K), not referee marginal
+    fits."""
     # hist rows + the flight-recorder rows (reserved whether or not the
     # caller passes a flight — the predicate must not flip per call).
     block = (_state_words_per_group(cfg) * 4 * GB
-             + HIST_SIZE * 4 * SUB * LANE
+             + _n_row_metrics(cfg) * HIST_SIZE * 4 * SUB * LANE
              + len(FLIGHT_LEAVES) * FLIGHT_RING * 4 * SUB * LANE)
     return 5 * block
 
 
 def wire_words_per_group(cfg: RaftConfig, with_flight: bool = True) -> int:
-    """i32 words per group of the kernel wire form: node + mailbox
-    leaves, alive_prev + group_id, the per-group metric lanes INCLUDING
-    the [H]-row in-kernel histogram, and (by default — `kinit` reserves
-    the predicate for it whether or not a flight rides) the six
-    flight-recorder ring rows. This is the HBM cost model the mesh-aware
-    `supported()` and `scripts/layout_probe.py` share; note the
-    histogram (HIST_SIZE words) and flight rings (6 x RING words) are
-    per-GROUP on the wire, unlike the XLA path's global [H] histogram —
-    the biggest non-state contributors to the G ceiling (DESIGN.md §9)."""
-    words = _state_words_per_group(cfg) + HIST_SIZE
+    """i32 words per group of the kernel wire form: node + mailbox +
+    client-state leaves, alive_prev + group_id, the per-group metric
+    lanes INCLUDING the [H]-row in-kernel histogram(s) (two with
+    clients on: election latency + client ack latency), and (by
+    default — `kinit` reserves the predicate for it whether or not a
+    flight rides) the six flight-recorder ring rows. This is the HBM
+    cost model the mesh-aware `supported()` and
+    `scripts/layout_probe.py` share; note the histograms (HIST_SIZE
+    words each) and flight rings (6 x RING words) are per-GROUP on the
+    wire, unlike the XLA path's global [H] histograms — the biggest
+    non-state contributors to the G ceiling (DESIGN.md §9/§10)."""
+    words = _state_words_per_group(cfg) + _n_row_metrics(cfg) * HIST_SIZE
     if with_flight:
         words += len(FLIGHT_LEAVES) * FLIGHT_RING
     return words
@@ -265,8 +280,11 @@ def _lset(arr, idx, cond, val):
 def _put(arr, p: int, cond, val):
     """Masked write of row p (static): the kernel's `step._put`. Bool
     rows use and/or masking with literal True/False short-circuited,
-    keeping vector i1 constants out of the program (module docstring)."""
-    m = (_col(arr.shape[0]) == p) & cond
+    keeping vector i1 constants out of the program (module docstring).
+    The row-select iota matches `arr`'s rank (ndim-4 for the [K, S]
+    session-table mailbox leaf, ndim-3 for everything else)."""
+    m = (jax.lax.broadcasted_iota(
+        I32, (arr.shape[0],) + (1,) * (arr.ndim - 1), 0) == p) & cond
     if arr.dtype == jnp.bool_:
         if val is True:
             return arr | m
@@ -638,6 +656,13 @@ def _on_is_req(cfg, ns, out, g, i, src: int, ib, gl):
     inst = ok & ~have
     keep = (inst & (m_si <= ns.last_index) & (m_si >= ns.snap_index)
             & (_term_at(cfg, ns, jnp.maximum(m_si, ns.snap_index)) == m_st))
+    sess = {}
+    if cfg.clients_u32:
+        # step._on_is_req: the snapshot's dedup table installs by value.
+        m_sess = ib.is_req_snap_sessions[src]
+        sess = dict(session_seq=jnp.where(inst, m_sess, ns.session_seq),
+                    snap_session_seq=jnp.where(inst, m_sess,
+                                               ns.snap_session_seq))
     ns = ns._replace(
         last_index=jnp.where(inst, jnp.where(keep, ns.last_index, m_si),
                              ns.last_index),
@@ -648,6 +673,7 @@ def _on_is_req(cfg, ns, out, g, i, src: int, ib, gl):
         commit=jnp.where(inst, m_si, ns.commit),
         applied=jnp.where(inst, m_si, ns.applied),
         digest=jnp.where(inst, m_sd, ns.digest),
+        **sess,
     )
     match = jnp.where(stale, 0, jnp.where(have, ns.commit, m_si))
     out = out._replace(
@@ -790,6 +816,9 @@ def _phase_t(cfg, ns, out, g, i, t):
             is_req_snap_voters=_put(out.is_req_snap_voters, p, use_is,
                                     ns.snap_voters),
         )
+        if cfg.clients_u32:
+            out = out._replace(is_req_snap_sessions=_put(
+                out.is_req_snap_sessions, p, use_is, ns.snap_session_seq))
         prev = next_p - 1
         n = jnp.minimum(cfg.max_entries_per_msg, ns.last_index - prev)
         out = out._replace(
@@ -861,7 +890,7 @@ def _phase_t(cfg, ns, out, g, i, t):
     return _start_election_masked(cfg, ns, out, g, i, timeout)
 
 
-def _phase_c(cfg, ns, g, t):
+def _phase_c(cfg, ns, g, t, csub=None, cpay=None):
     lead = ns.role == LEADER
 
     if cfg.read_every:
@@ -903,6 +932,21 @@ def _phase_c(cfg, ns, g, t):
     last_index = ns.last_index
     log_term, log_payload = ns.log_term, ns.log_payload
     stopped = lead & (g < 0)                    # all-false, constant-free
+    if cfg.clients_u32:
+        # step._phase_c client block: every self-believed leader
+        # appends the pulsed session ops in slot order, stopping at
+        # window-full (dual-leader duplicates are the dedup fold's
+        # job).
+        for sl in range(cfg.client_slots):
+            idx = last_index + 1
+            room = (idx - ns.snap_index) <= cfg.log_cap
+            want = lead & (csub[sl] != 0)
+            do = want & room & ~stopped
+            s = _slot(cfg, idx)
+            log_term = _lset(log_term, s, do, ns.term)
+            log_payload = _lset(log_payload, s, do, cpay[sl])
+            last_index = jnp.where(do, idx, last_index)
+            stopped = stopped | (want & ~room)
     for _ in range(cfg.cmds_per_tick):
         idx = last_index + 1
         room = (idx - ns.snap_index) <= cfg.log_cap
@@ -961,18 +1005,40 @@ def _phase_a(cfg, ns, i):
         )
         ns = _drop_reads(cfg, ns, demote)
 
+    # Apply loop with the exactly-once filter (step._phase_a): a
+    # session command folds — and advances the [S] dedup table — iff
+    # its seq strictly advances the sid's entry (sids pre-registered
+    # 0..S-1; out-of-range sid == unknown session == no-op).
     applied, digest = ns.applied, ns.digest
+    table = ns.session_seq
     for _ in range(cfg.log_cap):
         idx = applied + 1
         act = idx <= commit
-        digest = jnp.where(
-            act, jrng.digest_update(digest, idx, _payload_at(cfg, ns, idx)),
-            digest)
+        p = _payload_at(cfg, ns, idx)
+        if cfg.clients_u32:
+            is_sess = ((p & SESSION_FLAG) != 0) & ((p & CONFIG_FLAG) == 0)
+            sid = (p >> SESSION_SID_SHIFT) & SESSION_SID_MASK
+            seq = (p >> SESSION_SEQ_SHIFT) & SESSION_SEQ_MASK
+            # _lget's in-range contract holds only under sid < S; an
+            # out-of-range sid reads garbage that the eff_sess gate
+            # discards, and _lset's one-hot cannot write it anywhere.
+            cur = _lget(table, sid)
+            eff_sess = is_sess & (sid < cfg.client_slots) & (seq > cur)
+            table = _lset(table, sid, act & eff_sess, seq)
+            fold = act & (~is_sess | eff_sess)
+        else:
+            fold = act
+        digest = jnp.where(fold, jrng.digest_update(digest, idx, p), digest)
         applied = jnp.where(act, idx, applied)
 
     compact = (commit - ns.snap_index) >= cfg.compact_every
+    sess = {}
+    if cfg.clients_u32:
+        sess = dict(session_seq=table,
+                    snap_session_seq=jnp.where(compact, table,
+                                               ns.snap_session_seq))
     ns = ns._replace(
-        commit=commit, applied=applied, digest=digest,
+        commit=commit, applied=applied, digest=digest, **sess,
         snap_term=jnp.where(compact, _term_at(cfg, ns, commit), ns.snap_term),
         snap_voters=jnp.where(compact, _committed_voters(cfg, ns, commit),
                               ns.snap_voters),
@@ -1007,10 +1073,12 @@ def _phase_a(cfg, ns, i):
     return ns
 
 
-def _node_tick(cfg, t, ns: PerNode, inbox, g, i, glog_t, glog_p):
+def _node_tick(cfg, t, ns: PerNode, inbox, g, i, glog_t, glog_p,
+               csub=None, cpay=None):
     """step._node_tick, [8,128]-tile flavor; vmapped over the node axis.
-    The empty outbox derives its all-false rows from runtime data
-    (module docstring)."""
+    `csub`/`cpay` are the [S, 8, 128] client submit pulses + payloads,
+    broadcast across nodes (None with clients off). The empty outbox
+    derives its all-false rows from runtime data (module docstring)."""
     fK = jnp.broadcast_to(g, (cfg.k,) + g.shape) < 0
     zK = jnp.zeros((cfg.k, 1, 1), I32) + (g & 0)
     zKu = zK.astype(jnp.uint32)
@@ -1021,6 +1089,9 @@ def _node_tick(cfg, t, ns: PerNode, inbox, g, i, glog_t, glog_p):
                   pv_resp_req_term=zK, pv_resp_granted=fK)
     if cfg.transfer_u32:
         pv.update(tn_present=fK, tn_term=zK)
+    if cfg.clients_u32:
+        pv["is_req_snap_sessions"] = \
+            jnp.zeros((cfg.k, cfg.client_slots, 1, 1), I32) + (g & 0)
     out = Mailbox(
         rv_req_present=fK, rv_resp_present=fK, rv_resp_granted=fK,
         ae_req_present=fK, ae_resp_present=fK, ae_resp_success=fK,
@@ -1042,7 +1113,7 @@ def _node_tick(cfg, t, ns: PerNode, inbox, g, i, glog_t, glog_p):
         for src in range(cfg.k):
             ns, out = handler(cfg, ns, out, g, i, src, inbox, gl)
     ns, out = _phase_t(cfg, ns, out, g, i, t)
-    ns = _phase_c(cfg, ns, g, t)
+    ns = _phase_c(cfg, ns, g, t, csub, cpay)
     ns = _phase_a(cfg, ns, i)
     # Outbox bools leave the per-node step widened to i32: the vmap
     # out_axes=1 stacking transposes the node axis, and Mosaic's i1
@@ -1079,6 +1150,11 @@ def _apply_restart(cfg, nodes: PerNode, g, edge):
         ack_time=jnp.where(e1, -1, nodes.ack_time),
         sched_read_index=jnp.where(edge, -1, nodes.sched_read_index),
         reads_done=jnp.where(edge, 0, nodes.reads_done),
+        # Live dedup table rewinds to the snapshot table, like digest
+        # (step._apply_restart).
+        **({"session_seq": jnp.where(e1, nodes.snap_session_seq,
+                                     nodes.session_seq)}
+           if cfg.clients_u32 else {}),
     )
 
 
@@ -1112,8 +1188,9 @@ def _filter_mailbox(cfg, mb: Mailbox, t, alive_now, g) -> Mailbox:
     )
 
 
-def _tick(cfg, nodes, mailbox, alive_prev, g, t):
-    """step.tick over k-state values. g: [8,128] group ids; t: scalar."""
+def _tick(cfg, nodes, mailbox, alive_prev, clients, g, t):
+    """step.tick over k-state values. g: [8,128] group ids; t: scalar;
+    `clients` the [S, 8, 128]-leaf ClientState (None when off)."""
     kio = jax.lax.broadcasted_iota(I32, (cfg.k, 1, 1), 0)
     if cfg.crash_u32 == 0:
         alive_now = jnp.broadcast_to(g[None], (cfg.k,) + g.shape) >= 0
@@ -1125,10 +1202,20 @@ def _tick(cfg, nodes, mailbox, alive_prev, g, t):
     nodes = _apply_restart(cfg, nodes, g, alive_now & ~alive_prev)
     inbox = _filter_mailbox(cfg, mailbox, t, alive_now, g)
 
+    csub = cpay = None
+    if cfg.clients_u32:
+        # Start-of-tick submit pulses + payloads (step.tick's client
+        # block, [S, 8, 128] tiles): the SAME elementwise
+        # clients/workload.py code as the XLA path, on kernel layouts.
+        sio = jax.lax.broadcasted_iota(I32, (cfg.client_slots, 1, 1), 0)
+        csub, cpay = _workload.submit_payloads(cfg, clients, g[None], sio)
+
     node_fn = functools.partial(_node_tick, cfg, t)
     new_nodes, outbox = jax.vmap(
-        node_fn, in_axes=(0, 0, None, 0, None, None), out_axes=(0, 1))(
-        nodes, inbox, g, kio, nodes.log_term, nodes.log_payload)
+        node_fn, in_axes=(0, 0, None, 0, None, None, None, None),
+        out_axes=(0, 1))(
+        nodes, inbox, g, kio, nodes.log_term, nodes.log_payload,
+        csub, cpay)
 
     def freeze(new, old):
         m = alive_now.reshape(
@@ -1158,7 +1245,14 @@ def _tick(cfg, nodes, mailbox, alive_prev, g, t):
         is_resp_present=erase(outbox.is_resp_present),
         **pv,
     )
-    return new_nodes, outbox, alive_now
+    if cfg.clients_u32:
+        # Post-tick client transition on the frozen state (step.tick's
+        # tail): table witness over the K axis, same elementwise update.
+        tmax = _workload.table_max(new_nodes.session_seq, node_axis=0)
+        sio = jax.lax.broadcasted_iota(I32, (cfg.client_slots, 1, 1), 0)
+        clients = _workload.client_update(cfg, clients, tmax, g[None],
+                                          sio, t)
+    return new_nodes, outbox, alive_now, clients
 
 
 # -------------------------------------------------------- kernel + wrapper
@@ -1188,21 +1282,31 @@ class KMetrics(NamedTuple):
     the XLA path's global scatter-add. `safety` is the per-group
     per-tick safety AND (run.Metrics.safety) — a pass-through lane:
     kinit loads the caller's bits, the kernel ANDs into them, kfinish
-    reads them back."""
-    committed: jnp.ndarray
-    leaderless: jnp.ndarray
-    elections: jnp.ndarray
-    max_latency: jnp.ndarray
-    safety: jnp.ndarray
-    hist: jnp.ndarray
+    reads them back. The client lanes (DESIGN.md §10; None with
+    clients off, like run.Metrics): `client_acked`/`client_retries`
+    are idempotent per-tick recomputes from the client state,
+    `client_max_lat` accumulates per group like max_latency, and
+    `client_hist` is a second [H, 8, 128] row set for ack latencies."""
+    committed: jnp.ndarray = None
+    leaderless: jnp.ndarray = None
+    elections: jnp.ndarray = None
+    max_latency: jnp.ndarray = None
+    safety: jnp.ndarray = None
+    hist: jnp.ndarray = None
+    client_acked: jnp.ndarray = None
+    client_retries: jnp.ndarray = None
+    client_max_lat: jnp.ndarray = None
+    client_hist: jnp.ndarray = None
 
 
-def _safety_tick(cfg, nodes):
+def _safety_tick(cfg, nodes, cl=None):
     """check.tick_safety on k-state tiles, one [8, 128] bit per group:
     election safety (pairwise leader term compare), digest agreement on
-    equal applied prefixes, per-node window bounds — term-for-term the
-    predicates in sim/check.py, statically unrolled over K (and K^2/2
-    pairs) like every other kernel reduction."""
+    equal applied prefixes, per-node window bounds, and (clients on)
+    the exactly-once invariant (check.client_safety: pairwise dedup-
+    table agreement + no table seq above the issued frontier) —
+    term-for-term the predicates in sim/check.py, statically unrolled
+    over K (and K^2/2 pairs) like every other kernel reduction."""
     ok = None
     for j in range(cfg.k):
         wb = ((nodes.applied[j] == nodes.commit[j])
@@ -1217,6 +1321,18 @@ def _safety_tick(cfg, nodes):
             split = ((nodes.applied[a] == nodes.applied[b])
                      & (nodes.digest[a] != nodes.digest[b]))
             ok = ok & ~clash & ~split
+    if cl is not None:
+        table = nodes.session_seq                     # [K, S, 8, 128]
+        for j in range(cfg.k):
+            for s in range(cfg.client_slots):
+                ok = ok & (table[j, s] <= cl.done[s])
+        for a in range(cfg.k):
+            for b in range(a + 1, cfg.k):
+                diff = None
+                for s in range(cfg.client_slots):
+                    d = table[a, s] != table[b, s]
+                    diff = d if diff is None else diff | d
+                ok = ok & ~((nodes.applied[a] == nodes.applied[b]) & diff)
     return ok
 
 
@@ -1232,18 +1348,44 @@ def _presence_fields(cfg):
     return [f for f in PRESENCE_FIELDS if f not in skip]
 
 
-def _metrics_tick(cfg, m: KMetrics, fl, nodes, mailbox, alive_now, t):
+def _metrics_tick(cfg, m: KMetrics, fl, nodes, mailbox, alive_now, t,
+                  cl=None):
     """run.metrics_update + obs.recorder.flight_update against k-state
-    values — histogram, safety bit, and (when `fl` is not None) the
-    flight-recorder ring. `mailbox` is the post-tick outbox (presence
-    already widened to i32); `t` the absolute tick."""
+    values — histograms, safety bit, client SLO lanes (`cl` is the
+    POST-transition client state, None with clients off), and (when
+    `fl` is not None) the flight-recorder ring. `mailbox` is the
+    post-tick outbox (presence already widened to i32); `t` the
+    absolute tick."""
     committed = jnp.maximum(m.committed, jnp.max(nodes.commit, axis=0))
     has_leader = jnp.any((nodes.role == LEADER) & alive_now, axis=0)
     done = has_leader & (m.leaderless > 0)
-    safe = _safety_tick(cfg, nodes)
+    safe = _safety_tick(cfg, nodes, cl)
     hsize = m.hist.shape[0]
     bucket = jnp.minimum(m.leaderless, hsize - 1)
     hrow = jax.lax.broadcasted_iota(I32, (hsize, 1, 1), 0)
+    clm = {}
+    if cl is not None:
+        # Client SLO lanes (run.metrics_update's client fold): acked /
+        # retry totals recomputed from the client state (idempotent),
+        # this tick's completion events one-hot-added into the
+        # per-group ack-latency rows (a `last_lat` of -1 — no event —
+        # matches no row), and the per-group running max.
+        acked = retries = None
+        for s in range(cfg.client_slots):
+            acked = cl.done[s] if acked is None else acked + cl.done[s]
+            retries = cl.retries[s] if retries is None \
+                else retries + cl.retries[s]
+        csize = m.client_hist.shape[0]
+        crow = jax.lax.broadcasted_iota(I32, (csize, 1, 1), 0)
+        chist = m.client_hist
+        cmax = m.client_max_lat
+        for s in range(cfg.client_slots):
+            ev = cl.last_lat[s] >= 0
+            chist = chist + ((crow == jnp.minimum(cl.last_lat[s], csize - 1))
+                             & ev).astype(I32)
+            cmax = jnp.maximum(cmax, jnp.where(ev, cl.last_lat[s], 0))
+        clm = dict(client_acked=acked, client_retries=retries,
+                   client_hist=chist, client_max_lat=cmax)
     met = KMetrics(
         committed=committed,
         leaderless=jnp.where(has_leader, 0, m.leaderless + 1),
@@ -1252,6 +1394,7 @@ def _metrics_tick(cfg, m: KMetrics, fl, nodes, mailbox, alive_now, t):
                                 jnp.where(done, m.leaderless, 0)),
         safety=jnp.where(safe, m.safety, 0),
         hist=m.hist + ((hrow == bucket) & done).astype(I32),
+        **clm,
     )
     if fl is None:
         return met, None
@@ -1282,11 +1425,18 @@ def _metrics_tick(cfg, m: KMetrics, fl, nodes, mailbox, alive_now, t):
     return met, fl
 
 
+_SESS_NODE_FIELDS = ("session_seq", "snap_session_seq")
+
+
 def _node_leaves(cfg):
-    """(field, kind) per PerNode leaf; kind: 'scalar'|'peer'|'ring'."""
+    """(field, kind) per PerNode leaf present under `cfg`;
+    kind: 'scalar'|'peer'|'ring'|'sess'. The session tables exist only
+    with scheduled clients on (None fields — sim/state.py)."""
     kinds = {"votes": "peer", "next_index": "peer", "match_index": "peer",
-             "ack_time": "peer", "log_term": "ring", "log_payload": "ring"}
-    return [(f, kinds.get(f, "scalar")) for f in PerNode._fields]
+             "ack_time": "peer", "log_term": "ring", "log_payload": "ring",
+             "session_seq": "sess", "snap_session_seq": "sess"}
+    return [(f, kinds.get(f, "scalar")) for f in PerNode._fields
+            if cfg.clients_u32 or f not in _SESS_NODE_FIELDS]
 
 
 def _mb_fields(cfg):
@@ -1300,6 +1450,8 @@ def _mb_fields(cfg):
         skip.update(_PV_MB)
     if not cfg.transfer_u32:
         skip.update(_TN_MB)
+    if not cfg.clients_u32:
+        skip.add("is_req_snap_sessions")
     return [f for f in Mailbox._fields if f not in skip]
 
 
@@ -1314,23 +1466,25 @@ def _unfold_g(a):
 
 def _to_kstate(cfg, st: State):
     """State (G a GB multiple) -> flat list of k-state arrays (leaf
-    order: node leaves, mailbox leaves, alive_prev, group_id; bools as
-    i32; trailing G folded to [GS, LANE])."""
+    order: node leaves, mailbox leaves, client-state leaves (clients
+    on), alive_prev, group_id; bools as i32; trailing G folded to
+    [GS, LANE]). Every leaf moves its leading G axis last — the one
+    transpose rule all ranks share ([G, K] -> [K, G],
+    [G, K, X] -> [K, X, G], [G, d, s, S] -> [d, s, S, G])."""
     out = []
-    for f, kind in _node_leaves(cfg):
-        a = getattr(st.nodes, f)
-        if kind == "scalar":
-            a = jnp.transpose(a, (1, 0))                  # [K, G]
-        else:
-            a = jnp.transpose(a, (1, 2, 0))               # [K, K|L, G]
+    for f, _ in _node_leaves(cfg):
+        a = jnp.moveaxis(getattr(st.nodes, f), 0, -1)
         if a.dtype == jnp.bool_:
             a = a.astype(I32)
         out.append(_fold_g(a))
     for f in _mb_fields(cfg):
-        a = jnp.transpose(getattr(st.mailbox, f), (1, 2, 0))
+        a = jnp.moveaxis(getattr(st.mailbox, f), 0, -1)
         if a.dtype == jnp.bool_:
             a = a.astype(I32)
         out.append(_fold_g(a))
+    if cfg.clients_u32:
+        for f in CLIENT_LEAVES:
+            out.append(_fold_g(jnp.moveaxis(getattr(st.clients, f), 0, -1)))
     out.append(_fold_g(jnp.transpose(st.alive_prev, (1, 0)).astype(I32)))
     out.append(_fold_g(st.group_id))
     return out
@@ -1341,28 +1495,27 @@ def _from_kstate(cfg, flat, g: int) -> State:
     any pad groups beyond `g`."""
     it = iter(a[..., :g] for a in flat)
     nd = {}
-    for f, kind in _node_leaves(cfg):
-        a = next(it)
-        if kind == "scalar":
-            a = jnp.transpose(a, (1, 0))
-        else:
-            a = jnp.transpose(a, (2, 0, 1))
-        nd[f] = a
+    for f, _ in _node_leaves(cfg):
+        nd[f] = jnp.moveaxis(next(it), -1, 0)
     nd["votes"] = nd["votes"].astype(BOOL)
     nd["snap_digest"] = nd["snap_digest"].astype(jnp.uint32)
     nd["digest"] = nd["digest"].astype(jnp.uint32)
     md = {}
     for f in _mb_fields(cfg):
-        a = jnp.transpose(next(it), (2, 0, 1))
+        a = jnp.moveaxis(next(it), -1, 0)
         if f in _MB_BOOL:
             a = a.astype(BOOL)
         elif f == "is_req_snap_digest":
             a = a.astype(jnp.uint32)
         md[f] = a
+    clients = None
+    if cfg.clients_u32:
+        clients = ClientState(**{f: jnp.moveaxis(next(it), -1, 0)
+                                 for f in CLIENT_LEAVES})
     alive = jnp.transpose(next(it), (1, 0)).astype(BOOL)
     gid = next(it)
     return State(nodes=PerNode(**nd), mailbox=Mailbox(**md),
-                 alive_prev=alive, group_id=gid)
+                 alive_prev=alive, group_id=gid, clients=clients)
 
 
 def _build_kernel(cfg, n_ticks, with_flight):
@@ -1371,9 +1524,9 @@ def _build_kernel(cfg, n_ticks, with_flight):
     between the group ids and the metric tail (wire order)."""
     node_kinds = _node_leaves(cfg)
     mb_fields = _mb_fields(cfg)
-    n_in = (len(node_kinds) + len(mb_fields) + 2    # + alive, gid
+    n_in = (_n_state_leaves(cfg)
             + (len(FLIGHT_LEAVES) if with_flight else 0)
-            + N_METRIC_LEAVES)
+            + _n_metric_leaves(cfg))
 
     def kernel(t0_ref, *refs):
         in_refs = refs[:n_in]
@@ -1395,12 +1548,16 @@ def _build_kernel(cfg, n_ticks, with_flight):
             elif f == "is_req_snap_digest":
                 a = a.astype(jnp.uint32)
             md[f] = a
+        cl = None
+        if cfg.clients_u32:
+            cl = ClientState(**{f: next(it)[:] for f in CLIENT_LEAVES})
         alive_prev = next(it)[:] != 0
         g = next(it)[:]
         fl = None
         if with_flight:
             fl = Flight(**{f: next(it)[:] for f in FLIGHT_LEAVES})
-        met = KMetrics(**{f: next(it)[:] for f in METRIC_LEAVES})
+        met = KMetrics(**{f: next(it)[:]
+                          for f in _active_metric_leaves(cfg)})
         nodes = PerNode(**nd)
         mailbox = Mailbox(**md)
         t0 = t0_ref[0]
@@ -1418,21 +1575,22 @@ def _build_kernel(cfg, n_ticks, with_flight):
                 lambda a, pr: a != 0 if pr.dtype == jnp.bool_ else a,
                 tree, proto)
 
-        proto = (nodes, mailbox, alive_prev)
+        proto = (nodes, mailbox, alive_prev, cl)
 
         def body(tt, carry):
             state_i, met, fl = carry
-            nodes, mailbox, alive_prev = narrow_like(state_i, proto)
-            nodes, mailbox, alive_now = _tick(cfg, nodes, mailbox,
-                                              alive_prev, g, t0 + tt)
+            nodes, mailbox, alive_prev, cl = narrow_like(state_i, proto)
+            nodes, mailbox, alive_now, cl = _tick(cfg, nodes, mailbox,
+                                                  alive_prev, cl, g,
+                                                  t0 + tt)
             met, fl = _metrics_tick(cfg, met, fl, nodes, mailbox,
-                                    alive_now, t0 + tt)
-            return widen((nodes, mailbox, alive_now)), met, fl
+                                    alive_now, t0 + tt, cl)
+            return widen((nodes, mailbox, alive_now, cl)), met, fl
 
         state_i, met, fl = jax.lax.fori_loop(
             0, n_ticks, body,
-            (widen((nodes, mailbox, alive_prev)), met, fl))
-        nodes, mailbox, alive_prev = narrow_like(state_i, proto)
+            (widen((nodes, mailbox, alive_prev, cl)), met, fl))
+        nodes, mailbox, alive_prev, cl = narrow_like(state_i, proto)
 
         ot = iter(out_refs)
         for f, _ in node_kinds:
@@ -1443,12 +1601,15 @@ def _build_kernel(cfg, n_ticks, with_flight):
             a = getattr(mailbox, f)
             next(ot)[:] = a.astype(I32) \
                 if a.dtype in (jnp.bool_, jnp.uint32) else a
+        if cfg.clients_u32:
+            for f in CLIENT_LEAVES:
+                next(ot)[:] = getattr(cl, f)
         next(ot)[:] = alive_prev.astype(I32)
         next(ot)[:] = g
         if with_flight:
             for f in FLIGHT_LEAVES:
                 next(ot)[:] = getattr(fl, f)
-        for f in METRIC_LEAVES:
+        for f in _active_metric_leaves(cfg):
             next(ot)[:] = getattr(met, f)
 
     return kernel
@@ -1467,7 +1628,7 @@ def _gspec(a):
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_ticks", "interpret"))
 def _prun_padded(cfg, leaves, t0, n_ticks, interpret=False):
-    with_flight = len(leaves) > _n_state_leaves(cfg) + N_METRIC_LEAVES
+    with_flight = len(leaves) > _n_state_leaves(cfg) + _n_metric_leaves(cfg)
     kernel = _build_kernel(cfg, n_ticks, with_flight)
     nb = leaves[0].shape[-2] // SUB
     in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
@@ -1509,7 +1670,7 @@ def kinit(cfg: RaftConfig, st: State, metrics: Metrics | None = None,
                          f"{GB}-group block")
     g = st.alive_prev.shape[0]
     if metrics is None:
-        metrics = metrics_init(g)
+        metrics = metrics_init(g, clients=cfg.clients_u32 != 0)
     pad = (-g) % pad_to
     if pad:
         # Pad groups simulate alongside (results sliced off at finish);
@@ -1520,12 +1681,8 @@ def kinit(cfg: RaftConfig, st: State, metrics: Metrics | None = None,
         stp = jax.tree.map(padg, st)
         stp = stp._replace(group_id=jnp.concatenate(
             [st.group_id, jnp.arange(g, g + pad, dtype=I32)]))
-        mc = jnp.pad(metrics.committed, (0, pad))
-        ml = jnp.pad(metrics.leaderless, (0, pad))
-        ms = jnp.pad(metrics.safety, (0, pad), constant_values=1)
     else:
-        stp, mc, ml, ms = (st, metrics.committed, metrics.leaderless,
-                           metrics.safety)
+        stp = st
     leaves = _to_kstate(cfg, stp)
     fleaves = []
     if flight is not None:
@@ -1535,16 +1692,31 @@ def kinit(cfg: RaftConfig, st: State, metrics: Metrics | None = None,
                 a = jnp.pad(a, ((0, 0), (0, pad)),
                             constant_values=-1 if name == "tick" else 0)
             fleaves.append(_fold_g(a))
-    # elections / max_latency / hist accumulate from zero in-kernel and
-    # kfinish folds the caller's metrics_base back in (scalars add,
-    # histograms add bucket-wise); committed / leaderless / safety are
-    # pass-through lanes the kernel continues in place. Nothing of
-    # `metrics` is lost either way. Order: METRIC_LEAVES.
-    mleaves = [_fold_g(mc), _fold_g(ml),
-               _fold_g(jnp.zeros(g + pad, I32)),
-               _fold_g(jnp.zeros(g + pad, I32)),
-               _fold_g(ms),
-               _fold_g(jnp.zeros((metrics.hist.shape[0], g + pad), I32))]
+    # elections / max_latency / hist / client_max_lat / client_hist
+    # accumulate from zero in-kernel and kfinish folds the caller's
+    # metrics_base back in (scalars add/max, histograms add
+    # bucket-wise); committed / leaderless / safety / client_acked /
+    # client_retries are pass-through lanes the kernel continues in
+    # place. Nothing of `metrics` is lost either way. Order:
+    # _active_metric_leaves(cfg).
+    def lane(a, fill=0):
+        a = jnp.zeros(g, I32) if a is None else a
+        return _fold_g(jnp.pad(a, (0, pad), constant_values=fill)
+                       if pad else a)
+
+    def rows():
+        return _fold_g(jnp.zeros((metrics.hist.shape[0], g + pad), I32))
+
+    mvals = {"committed": lane(metrics.committed),
+             "leaderless": lane(metrics.leaderless),
+             "elections": lane(None), "max_latency": lane(None),
+             "safety": lane(metrics.safety, fill=1),
+             "hist": rows()}
+    if cfg.clients_u32:
+        mvals.update(client_acked=lane(metrics.client_acked),
+                     client_retries=lane(metrics.client_retries),
+                     client_max_lat=lane(None), client_hist=rows())
+    mleaves = [mvals[n] for n in _active_metric_leaves(cfg)]
     return tuple(leaves + fleaves + mleaves), g
 
 
@@ -1558,56 +1730,101 @@ def kstep(cfg: RaftConfig, leaves, t0: int, n_ticks: int,
 
 
 METRIC_LEAVES = ("committed", "leaderless", "elections", "max_latency",
-                 "safety", "hist")   # wire order of the metric tail;
-#                  == KMetrics._fields (parity-checked); hist LAST
+                 "safety", "hist", "client_acked", "client_retries",
+                 "client_max_lat", "client_hist")
+# Wire order of the metric tail == KMetrics._fields (parity-checked by
+# scripts/check_metric_parity.py). The client leaves ride the wire only
+# when cfg.clients_u32 (`_active_metric_leaves`); they come AFTER the
+# protocol leaves so a clients-off wire is byte-identical to pre-r09.
+CLIENT_METRIC_LEAVES = ("client_acked", "client_retries",
+                        "client_max_lat", "client_hist")
+ROW_METRIC_LEAVES = ("hist", "client_hist")   # [H]-row (not lane) leaves
 N_METRIC_LEAVES = len(METRIC_LEAVES)
+
+
+def _active_metric_leaves(cfg) -> tuple:
+    """The metric leaves actually on the wire under `cfg`, in
+    METRIC_LEAVES order."""
+    if cfg.clients_u32:
+        return METRIC_LEAVES
+    return tuple(n for n in METRIC_LEAVES if n not in CLIENT_METRIC_LEAVES)
+
+
+def _n_metric_leaves(cfg) -> int:
+    return len(_active_metric_leaves(cfg))
+
+
+def _n_row_metrics(cfg) -> int:
+    """[H]-row metric leaves on the wire (1, or 2 with the client
+    ack-latency histogram)."""
+    return sum(1 for n in _active_metric_leaves(cfg)
+               if n in ROW_METRIC_LEAVES)
 
 
 def _n_state_leaves(cfg) -> int:
     """Wire leaves ahead of the (flight, metrics) tail: node + mailbox
-    leaves + alive_prev + group_id."""
-    return len(_node_leaves(cfg)) + len(_mb_fields(cfg)) + 2
+    leaves + the client-state leaves (clients on) + alive_prev +
+    group_id."""
+    return (len(_node_leaves(cfg)) + len(_mb_fields(cfg)) + 2
+            + (len(CLIENT_LEAVES) if cfg.clients_u32 else 0))
 
 
-def _mleaf(leaves, name: str):
-    """The named metric leaf of a wire tuple — indexed by METRIC_LEAVES
+def _mleaf(cfg, leaves, name: str):
+    """The named metric leaf of a wire tuple — indexed by active-leaf
     position from the END (the metric tail is last whether or not
     flight leaves ride the wire), so adding a leaf cannot silently
     shift the counters the bench reads (kcommitted/kelections/khist)."""
-    return leaves[METRIC_LEAVES.index(name) - N_METRIC_LEAVES]
+    active = _active_metric_leaves(cfg)
+    return leaves[active.index(name) - len(active)]
 
 
-def kcommitted(leaves, g: int) -> int:
+def kcommitted(cfg, leaves, g: int) -> int:
     """Host-side total committed rounds from the wire form (int64 sum —
     run.total_rounds semantics)."""
     import numpy as np
-    mc = np.asarray(_unfold_g(_mleaf(leaves, "committed")))[:g]
+    mc = np.asarray(_unfold_g(_mleaf(cfg, leaves, "committed")))[:g]
     return int(mc.astype(np.int64).sum())
 
 
-def kreads(leaves, g: int) -> int:
+def kreads(cfg, leaves, g: int) -> int:
     """Host-side total completed scheduled reads (sum of the per-node
     `reads_done` counters), straight from the wire form."""
     import numpy as np
-    idx = PerNode._fields.index("reads_done")
+    idx = [f for f, _ in _node_leaves(cfg)].index("reads_done")
     rd = np.asarray(_unfold_g(leaves[idx]))[..., :g]   # [K, g]
     return int(rd.astype(np.int64).sum())
 
 
-def kelections(leaves, g: int) -> int:
+def kelections(cfg, leaves, g: int) -> int:
     import numpy as np
-    me = np.asarray(_unfold_g(_mleaf(leaves, "elections")))[:g]
+    me = np.asarray(_unfold_g(_mleaf(cfg, leaves, "elections")))[:g]
     return int(me.astype(np.int64).sum())
 
 
-def khist(leaves, g: int):
-    """Host-side election-latency histogram from the wire form: the
-    per-group [H, G] accumulators of the real groups, reduced to the
-    run.Metrics [H] layout (i32 sum, matching the kernel's and the XLA
-    scatter-add's dtype — exact in any order). kfinish folds this into
-    its returned Metrics."""
+def kacked(cfg, leaves, g: int) -> int:
+    """Host-side client-visible committed ops (run.total_client_ops
+    semantics), straight from the wire form — the client segments'
+    timed counter."""
     import numpy as np
-    mh = np.asarray(_unfold_g(_mleaf(leaves, "hist")))[:, :g]
+    ma = np.asarray(_unfold_g(_mleaf(cfg, leaves, "client_acked")))[:g]
+    return int(ma.astype(np.int64).sum())
+
+
+def kretries(cfg, leaves, g: int) -> int:
+    import numpy as np
+    mr = np.asarray(_unfold_g(_mleaf(cfg, leaves, "client_retries")))[:g]
+    return int(mr.astype(np.int64).sum())
+
+
+def khist(cfg, leaves, g: int, name: str = "hist"):
+    """Host-side [H] histogram from the wire form: the per-group [H, G]
+    accumulators of the real groups, reduced to the run.Metrics [H]
+    layout (i32 sum, matching the kernel's and the XLA scatter-add's
+    dtype — exact in any order). `name` picks the election-latency
+    (default) or client ack-latency rows. kfinish folds this into its
+    returned Metrics."""
+    import numpy as np
+    mh = np.asarray(_unfold_g(_mleaf(cfg, leaves, name)))[:, :g]
     return mh.sum(axis=1, dtype=np.int32)
 
 
@@ -1615,7 +1832,7 @@ def kflight(cfg: RaftConfig, leaves, g: int) -> Flight | None:
     """Host-side Flight from the wire form ([RING, g] per leaf, pad
     groups sliced off), or None when kinit ran without a flight."""
     n_state = _n_state_leaves(cfg)
-    n_flight = len(leaves) - n_state - N_METRIC_LEAVES
+    n_flight = len(leaves) - n_state - _n_metric_leaves(cfg)
     if n_flight == 0:
         return None
     if n_flight != len(FLIGHT_LEAVES):
@@ -1640,20 +1857,41 @@ def kfinish(cfg: RaftConfig, leaves, g: int,
     XLA scatter-add). Flight leaves, when present, are skipped here —
     read them with `kflight`."""
     from raft_tpu.sim.run import metrics_init
+    clients_on = cfg.clients_u32 != 0
     if metrics_base is None:
-        metrics_base = metrics_init(g)
+        metrics_base = metrics_init(g, clients=clients_on)
     n_state = _n_state_leaves(cfg)
     st = _from_kstate(cfg, [_unfold_g(a) for a in leaves[:n_state]], g)
     mc, ml, me, mx, ms = [
-        _unfold_g(_mleaf(leaves, n))[:g]
+        _unfold_g(_mleaf(cfg, leaves, n))[:g]
         for n in ("committed", "leaderless", "elections", "max_latency",
                   "safety")]
+    cl = {}
+    if clients_on:
+        # Pass-through lanes read back; the accumulate-from-zero rows /
+        # maxes fold the base in, mirroring the protocol leaves (a base
+        # without client lanes contributes zeros).
+        ca, cr, cm = [_unfold_g(_mleaf(cfg, leaves, n))[:g]
+                      for n in ("client_acked", "client_retries",
+                                "client_max_lat")]
+        base_h = (metrics_base.client_hist
+                  if metrics_base.client_hist is not None
+                  else jnp.zeros((), I32))
+        base_m = (metrics_base.client_max_lat
+                  if metrics_base.client_max_lat is not None
+                  else jnp.zeros((), I32))
+        cl = dict(client_acked=ca, client_retries=cr,
+                  client_hist=base_h + khist(cfg, leaves, g,
+                                             name="client_hist"),
+                  client_max_lat=jnp.maximum(jnp.asarray(base_m, I32),
+                                             jnp.max(cm)))
     met = Metrics(
         committed=mc, leaderless=ml,
         elections=metrics_base.elections + jnp.sum(me),
-        hist=metrics_base.hist + khist(leaves, g),
+        hist=metrics_base.hist + khist(cfg, leaves, g),
         max_latency=jnp.maximum(metrics_base.max_latency, jnp.max(mx)),
         safety=ms,
+        **cl,
     )
     return st, met
 

@@ -62,9 +62,28 @@ class Metrics(NamedTuple):
     hist: jnp.ndarray        # i32[H] — election-latency histogram
     max_latency: jnp.ndarray  # i32 — exact longest completed streak
     safety: jnp.ndarray      # i32[G] — per-tick safety AND (1 = never bad)
+    # Client-visible SLO lanes (DESIGN.md §10) — present only when the
+    # scheduled client traffic is on (None = empty subtree, keeping
+    # clients-off metric pytrees identical to pre-r09). The safety
+    # lane above then also latches the exactly-once invariant
+    # (check.client_safety) every tick.
+    client_acked: jnp.ndarray | None = None    # i32[G] — ops acked
+    client_retries: jnp.ndarray | None = None  # i32[G] — re-submissions
+    client_hist: jnp.ndarray | None = None     # i32[H] — ack-latency hist
+    client_max_lat: jnp.ndarray | None = None  # i32 — longest acked op
 
 
-def metrics_init(n_groups: int, hist_size: int = HIST_SIZE) -> Metrics:
+def metrics_init(n_groups: int, hist_size: int = HIST_SIZE,
+                 clients: bool = False) -> Metrics:
+    """Zero metrics; pass `clients=True` for a scheduled-client
+    universe (the lanes are folded iff `State.clients` is present, so
+    a mismatched flag fails loudly in metrics_update, not silently)."""
+    cl = {}
+    if clients:
+        cl = dict(client_acked=jnp.zeros(n_groups, I32),
+                  client_retries=jnp.zeros(n_groups, I32),
+                  client_hist=jnp.zeros(hist_size, I32),
+                  client_max_lat=jnp.zeros((), I32))
     return Metrics(
         committed=jnp.zeros(n_groups, I32),
         leaderless=jnp.zeros(n_groups, I32),
@@ -72,6 +91,7 @@ def metrics_init(n_groups: int, hist_size: int = HIST_SIZE) -> Metrics:
         hist=jnp.zeros(hist_size, I32),
         max_latency=jnp.zeros((), I32),
         safety=jnp.ones(n_groups, I32),
+        **cl,
     )
 
 
@@ -84,7 +104,30 @@ def metrics_update(m: Metrics, st: State, log_cap: int) -> Metrics:
     done = has_leader & (m.leaderless > 0)
     hist_size = m.hist.shape[0]
     bucket = jnp.minimum(m.leaderless, hist_size - 1)
-    return Metrics(
+    cl = {}
+    if st.clients is not None:
+        if m.client_acked is None:
+            raise ValueError(
+                "state carries client traffic but the metrics have no "
+                "client lanes — init with metrics_init(g, clients=True)")
+        c = st.clients
+        # Acked/retry totals are monotone client-state counters —
+        # recomputed per tick (idempotent), not accumulated, so chunk
+        # boundaries cannot double-count. The ack-latency histogram
+        # folds this tick's completion events (`last_lat` >= 0, one
+        # per slot at most), exactly like the election histogram folds
+        # completed leaderless streaks.
+        ev = c.last_lat >= 0
+        cb = jnp.where(ev, jnp.minimum(c.last_lat, hist_size - 1), 0)
+        cl = dict(
+            client_acked=jnp.sum(c.done, axis=1),
+            client_retries=jnp.sum(c.retries, axis=1),
+            client_hist=m.client_hist.at[cb.ravel()].add(
+                ev.ravel().astype(I32)),
+            client_max_lat=jnp.maximum(
+                m.client_max_lat, jnp.max(jnp.where(ev, c.last_lat, 0))),
+        )
+    return m._replace(
         committed=committed,
         leaderless=jnp.where(has_leader, 0, m.leaderless + 1),
         elections=m.elections + jnp.sum(done.astype(I32)),
@@ -92,6 +135,7 @@ def metrics_update(m: Metrics, st: State, log_cap: int) -> Metrics:
         max_latency=jnp.maximum(
             m.max_latency, jnp.max(jnp.where(done, m.leaderless, 0))),
         safety=jnp.where(check.tick_safety(st, log_cap), m.safety, 0),
+        **cl,
     )
 
 
@@ -104,7 +148,8 @@ def run(cfg: RaftConfig, st: State, n_ticks: int, t0=0,
     state and `t0 + n_ticks` to continue the same deterministic universe.
     """
     if metrics is None:
-        metrics = metrics_init(st.alive_prev.shape[0])
+        metrics = metrics_init(st.alive_prev.shape[0],
+                               clients=st.clients is not None)
 
     def body(carry, t):
         s, m = carry
@@ -160,6 +205,18 @@ def unsafe_groups(metrics: Metrics) -> int:
     any point in the run (0 = the whole run was a clean soak). Benches,
     the dryrun, and the kernel sweep print this next to every number."""
     return int((np.asarray(metrics.safety) == 0).sum())
+
+
+def total_client_ops(metrics: Metrics) -> int:
+    """Client-visible committed ops (acked exactly-once) across groups,
+    host-side int64 — the client-SLO analogue of total_rounds."""
+    return int(np.asarray(metrics.client_acked).astype(np.int64).sum())
+
+
+def total_client_retries(metrics: Metrics) -> int:
+    """Re-submissions across groups — every one a potential duplicate
+    log entry the exactly-once fold must (and provably does) skip."""
+    return int(np.asarray(metrics.client_retries).astype(np.int64).sum())
 
 
 def latency_censored(hist, q: float) -> bool:

@@ -77,6 +77,18 @@ class PerNode(NamedTuple):
     sched_read_index: jnp.ndarray   # i32 — read point, -1 = none
     sched_read_reg: jnp.ndarray     # i32 — registration tick
     reads_done: jnp.ndarray         # i32 — completed linearizable reads
+    # Exactly-once session dedup tables (DESIGN.md §10; node.py
+    # `sessions` / `snap_sessions`) — present only when the scheduled
+    # client traffic is on (cfg.clients_u32; None otherwise, so
+    # clients-off programs carry zero extra arrays and stay
+    # byte-identical to pre-r09 builds, the pv_* mailbox trick).
+    # `session_seq[sid]` is the highest client seq APPLIED for that
+    # pre-registered sid (-1 = none): pure state-machine state, rebuilt
+    # like `digest` — live table tracks the applied prefix, snapshot
+    # table is the durable copy compaction writes and restart /
+    # InstallSnapshot rewind to.
+    session_seq: jnp.ndarray | None = None       # i32[S], live table
+    snap_session_seq: jnp.ndarray | None = None  # i32[S], snapshot table
 
 
 class Mailbox(NamedTuple):
@@ -148,6 +160,14 @@ class Mailbox(NamedTuple):
     tn_present: jnp.ndarray | None = None       # bool
     tn_term: jnp.ndarray | None = None          # i32
 
+    # InstallSnapshot's session-table payload (DESIGN.md §10) — the
+    # snapshot dedup table rides the message BY VALUE like the other
+    # snap_* fields (the sender may compact between send and delivery,
+    # so a receiver-pull of its CURRENT snapshot table would diverge
+    # from the oracle). Present only with scheduled clients on;
+    # meaningful under is_req_present.
+    is_req_snap_sessions: jnp.ndarray | None = None  # i32[..., S]
+
 
 class State(NamedTuple):
     nodes: PerNode        # leaves [G, K, ...]
@@ -158,14 +178,22 @@ class State(NamedTuple):
     # axis keeps simulating its own groups' seed streams: inside shard_map
     # an arange over the local shape would alias every shard onto groups
     # [0, G_local), silently duplicating universes.
+    #
+    # Open-loop client-side state (clients/state.py, [G, S] leaves) —
+    # present only when the scheduled client traffic is on (None = an
+    # empty subtree, keeping clients-off pytrees identical to pre-r09).
+    # Environment state like the fault schedules, NOT replicated state:
+    # the tick consumes its submit pulses in phase C and the post-tick
+    # client transition (clients/workload.py) rewrites it.
+    clients: "ClientState | None" = None
 
 
 def empty_mailbox(lead_shape: tuple, prevote: bool = False,
-                  transfer: bool = False) -> Mailbox:
+                  transfer: bool = False, client_slots: int = 0) -> Mailbox:
     """Zero mailbox with the given leading shape: `(g, k, k)` for the
     in-flight buffer ([G, dst, src]), `(k,)` for a per-node outbox inside
-    the vmapped step. PreVote / TimeoutNow slots are materialized only
-    when their schedules are on."""
+    the vmapped step. PreVote / TimeoutNow / session-table slots are
+    materialized only when their schedules are on."""
     def z(dtype, *extra):
         return jnp.zeros(tuple(lead_shape) + extra, dtype)
 
@@ -177,6 +205,8 @@ def empty_mailbox(lead_shape: tuple, prevote: bool = False,
                   pv_resp_req_term=z(I32), pv_resp_granted=z(BOOL))
     if transfer:
         pv.update(tn_present=z(BOOL), tn_term=z(I32))
+    if client_slots:
+        pv["is_req_snap_sessions"] = z(I32, client_slots)
     return Mailbox(
         rv_req_present=z(BOOL), rv_req_term=z(I32), rv_req_lli=z(I32),
         rv_req_llt=z(I32),
@@ -209,6 +239,14 @@ def init(cfg: RaftConfig, n_groups: int | None = None) -> State:
     def z(dtype, *extra):
         return jnp.zeros((g, k) + extra, dtype)
 
+    sess = {}
+    if cfg.clients_u32:
+        # Slots 0..S-1 are born registered with no applied commands
+        # (table value -1) — bit-matching Node.__init__'s pre-registered
+        # snap_sessions under the same config.
+        sess = dict(
+            session_seq=jnp.full((g, k, cfg.client_slots), -1, I32),
+            snap_session_seq=jnp.full((g, k, cfg.client_slots), -1, I32))
     nodes = PerNode(
         term=z(I32),
         voted_for=jnp.full((g, k), NO_VOTE, I32),
@@ -230,11 +268,18 @@ def init(cfg: RaftConfig, n_groups: int | None = None) -> State:
         sched_read_index=jnp.full((g, k), -1, I32),
         sched_read_reg=z(I32),
         reads_done=z(I32),
+        **sess,
     )
+    clients = None
+    if cfg.clients_u32:
+        from raft_tpu.clients.state import clients_init
+        clients = clients_init(cfg, g)
     return State(
         nodes=nodes,
         mailbox=empty_mailbox((g, k, k), cfg.prevote,
-                              cfg.transfer_u32 != 0),
+                              cfg.transfer_u32 != 0,
+                              cfg.client_slots if cfg.clients_u32 else 0),
         alive_prev=jnp.ones((g, k), BOOL),
         group_id=jnp.arange(g, dtype=I32),
+        clients=clients,
     )

@@ -34,7 +34,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from raft_tpu.config import CONFIG_FLAG, RaftConfig
+from raft_tpu.config import (CONFIG_FLAG, SESSION_FLAG, SESSION_SEQ_MASK,
+                             SESSION_SEQ_SHIFT, SESSION_SID_MASK,
+                             SESSION_SID_SHIFT, RaftConfig)
 from raft_tpu.core.node import (CANDIDATE, FOLLOWER, LEADER, NO_VOTE,
                                 PRECANDIDATE)
 from raft_tpu.ops import quorum
@@ -420,6 +422,15 @@ def _on_is_req(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
     # suffix means last_index is simply left alone (slots are absolute).
     keep = (inst & (m_si <= ns.last_index) & (m_si >= ns.snap_index)
             & (_term_at(cfg, ns, jnp.maximum(m_si, ns.snap_index)) == m_st))
+    sess = {}
+    if cfg.clients_u32:
+        # The snapshot's dedup table installs with the rest of the
+        # snapshot state (node.py _on_is_req: snap_sessions from the
+        # message, live sessions rebuilt from it).
+        m_sess = ib.is_req_snap_sessions[src]
+        sess = dict(session_seq=jnp.where(inst, m_sess, ns.session_seq),
+                    snap_session_seq=jnp.where(inst, m_sess,
+                                               ns.snap_session_seq))
     ns = ns._replace(
         last_index=jnp.where(inst, jnp.where(keep, ns.last_index, m_si),
                              ns.last_index),
@@ -430,6 +441,7 @@ def _on_is_req(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
         commit=jnp.where(inst, m_si, ns.commit),
         applied=jnp.where(inst, m_si, ns.applied),
         digest=jnp.where(inst, m_sd, ns.digest),
+        **sess,
     )
     match = jnp.where(stale, 0, jnp.where(have, ns.commit, m_si))
     out = out._replace(
@@ -581,6 +593,9 @@ def _phase_t(cfg, ns, out, g, i, t):
             is_req_snap_voters=_put(out.is_req_snap_voters, p, use_is,
                                     ns.snap_voters),
         )
+        if cfg.clients_u32:
+            out = out._replace(is_req_snap_sessions=_put(
+                out.is_req_snap_sessions, p, use_is, ns.snap_session_seq))
         # No entry gather: the receiver pulls (prev, prev+n] out of this
         # sender's ring at delivery time (see Mailbox docstring) — the
         # send-side gather loop this replaces was the hottest op group
@@ -664,9 +679,12 @@ def _phase_t(cfg, ns, out, g, i, t):
 # ----------------------------------------------------------------- phase C
 
 
-def _phase_c(cfg, ns, g, t):
+def _phase_c(cfg, ns, g, t, csub=None, cpay=None):
     """`Node.phase_c`: scheduled read registration (DESIGN.md §2c),
-    scheduled membership proposal (DESIGN.md §2b), then client command
+    scheduled membership proposal (DESIGN.md §2b), then open-loop
+    client session appends (DESIGN.md §10 — `csub`/`cpay` are the
+    [S] submit pulses and payloads raised by the PREVIOUS tick's
+    client transition; None with clients off), then fire-hose command
     appends."""
     lead = ns.role == LEADER
 
@@ -707,6 +725,21 @@ def _phase_c(cfg, ns, g, t):
     last_index = ns.last_index
     log_term, log_payload = ns.log_term, ns.log_payload
     stopped = jnp.zeros((), BOOL)
+    if cfg.clients_u32:
+        # EVERY node that believes itself leader appends the pulsed
+        # session ops, in slot order, stopping at window-full (the
+        # oracle's `phase_c(client_cmds)` break). Duplicate appends by
+        # transient dual leaders are safe by the exactly-once fold.
+        for sl in range(cfg.client_slots):
+            idx = last_index + 1
+            room = (idx - ns.snap_index) <= cfg.log_cap
+            want = lead & (csub[sl] != 0)
+            do = want & room & ~stopped
+            s = _slot(cfg, idx)
+            log_term = _lset(log_term, s, do, ns.term)
+            log_payload = _lset(log_payload, s, do, cpay[sl])
+            last_index = jnp.where(do, idx, last_index)
+            stopped = stopped | (want & ~room)
     for _ in range(cfg.cmds_per_tick):
         idx = last_index + 1
         room = (idx - ns.snap_index) <= cfg.log_cap
@@ -757,19 +790,42 @@ def _phase_a(cfg, ns, i):
 
     # Apply loop: commit - applied <= L by the window invariant, so an
     # L-step unrolled chain covers it. The digest chain is inherently
-    # sequential (node.py:369-374).
+    # sequential (node.py:369-374). With scheduled clients on, the
+    # exactly-once filter (node.py `_session_effective`, scheduled
+    # form) runs at digest-fold time: sids are pre-registered 0..S-1
+    # and REGISTER entries cannot occur in a scheduled universe, so
+    # "sid unknown" == sid >= S; a session command folds — and
+    # advances the dedup table — iff its seq strictly advances the
+    # sid's entry. The table IS the dedup decision record.
     applied, digest = ns.applied, ns.digest
+    table = ns.session_seq
     for _ in range(cfg.log_cap):
         idx = applied + 1
         act = idx <= commit
-        digest = jnp.where(
-            act, jrng.digest_update(digest, idx, _payload_at(cfg, ns, idx)),
-            digest)
+        p = _payload_at(cfg, ns, idx)
+        if cfg.clients_u32:
+            is_sess = ((p & SESSION_FLAG) != 0) & ((p & CONFIG_FLAG) == 0)
+            sid = (p >> SESSION_SID_SHIFT) & SESSION_SID_MASK
+            seq = (p >> SESSION_SEQ_SHIFT) & SESSION_SEQ_MASK
+            cur = _lget(table, sid)
+            eff_sess = is_sess & (sid < cfg.client_slots) & (seq > cur)
+            table = _lset(table, sid, act & eff_sess, seq)
+            fold = act & (~is_sess | eff_sess)
+        else:
+            fold = act
+        digest = jnp.where(fold, jrng.digest_update(digest, idx, p), digest)
         applied = jnp.where(act, idx, applied)
 
     compact = (commit - ns.snap_index) >= cfg.compact_every
+    sess = {}
+    if cfg.clients_u32:
+        # Compaction folds the live table into the snapshot (node.py
+        # phase_a: `snap_sessions = dict(sessions)`).
+        sess = dict(session_seq=table,
+                    snap_session_seq=jnp.where(compact, table,
+                                               ns.snap_session_seq))
     ns = ns._replace(
-        commit=commit, applied=applied, digest=digest,
+        commit=commit, applied=applied, digest=digest, **sess,
         snap_term=jnp.where(compact, _term_at(cfg, ns, commit), ns.snap_term),
         snap_voters=jnp.where(compact, _committed_voters(cfg, ns, commit),
                               ns.snap_voters),
@@ -806,7 +862,8 @@ def _phase_a(cfg, ns, i):
 # ------------------------------------------------------------ per-node tick
 
 
-def _node_tick(cfg, t, ns: PerNode, inbox: Mailbox, g, i, glog_t, glog_p):
+def _node_tick(cfg, t, ns: PerNode, inbox: Mailbox, g, i, glog_t, glog_p,
+               csub=None, cpay=None):
     """One node's full D/T/C/A tick. `inbox` leaves lead with [K_src];
     the returned outbox leaves lead with [K_dst]. `t` is the absolute
     tick (the reconfig schedule hashes it). `glog_t`/`glog_p` are the
@@ -819,14 +876,15 @@ def _node_tick(cfg, t, ns: PerNode, inbox: Mailbox, g, i, glog_t, glog_p):
     15.4 ms/tick at 100K groups, 5x the compile time): [G]-shaped ops
     lose more to per-op overhead and lost cross-node fusion than the
     skipped fifth of phase D saves. Keep the [G, K] double-vmap."""
-    out = empty_mailbox((cfg.k,), cfg.prevote, cfg.transfer_u32 != 0)
+    out = empty_mailbox((cfg.k,), cfg.prevote, cfg.transfer_u32 != 0,
+                        cfg.client_slots if cfg.clients_u32 else 0)
     gl = (glog_t, glog_p, t)   # phase-D context: group logs + the clock
     # Phase D: canonical (type, src) order — node.py:154 + rpc.sort_inbox.
     for handler in _HANDLERS:
         for src in range(cfg.k):
             ns, out = handler(cfg, ns, out, g, i, src, inbox, gl)
     ns, out = _phase_t(cfg, ns, out, g, i, t)
-    ns = _phase_c(cfg, ns, g, t)
+    ns = _phase_c(cfg, ns, g, t, csub, cpay)
     ns = _phase_a(cfg, ns, i)
     return ns, out
 
@@ -859,6 +917,12 @@ def _apply_restart(cfg, nodes: PerNode, g_grid, i_grid, edge):
         ack_time=jnp.where(e1, -1, nodes.ack_time),
         sched_read_index=jnp.where(edge, -1, nodes.sched_read_index),
         reads_done=jnp.where(edge, 0, nodes.reads_done),
+        # The live dedup table is pure state-machine state: restart
+        # rewinds it to the snapshot table, like digest (node.py
+        # restart: `sessions = dict(snap_sessions)`).
+        **({"session_seq": jnp.where(e1, nodes.snap_session_seq,
+                                     nodes.session_seq)}
+           if cfg.clients_u32 else {}),
     )
 
 
@@ -913,11 +977,23 @@ def tick(cfg: RaftConfig, st: State, t) -> State:
     # with no whole-mailbox transpose between ticks.
     inbox = _filter_mailbox(cfg, st.mailbox, t, alive_now, st.group_id)
 
+    csub = cpay = None
+    if cfg.clients_u32:
+        # The submit pulses raised by the PREVIOUS tick's client
+        # transition, with their payloads ([G, S] each; broadcast to
+        # every node in the group — a client talks to whoever claims
+        # leadership).
+        from raft_tpu.clients import workload
+        scol = jnp.arange(cfg.client_slots, dtype=I32)[None, :]
+        csub, cpay = workload.submit_payloads(cfg, st.clients,
+                                              st.group_id[:, None], scol)
+
     node_fn = functools.partial(_node_tick, cfg, t)
     new_nodes, outbox = jax.vmap(
-        jax.vmap(node_fn, in_axes=(0, 0, 0, 0, None, None),
+        jax.vmap(node_fn, in_axes=(0, 0, 0, 0, None, None, None, None),
                  out_axes=(0, 1)))(
-        nodes, inbox, g_grid, i_grid, nodes.log_term, nodes.log_payload)
+        nodes, inbox, g_grid, i_grid, nodes.log_term, nodes.log_payload,
+        csub, cpay)
 
     # Dead nodes: state frozen, sends erased (cluster.py:103-119 runs no
     # phase for them; transport keeps their in-flight mail).
@@ -942,5 +1018,15 @@ def tick(cfg: RaftConfig, st: State, t) -> State:
         is_resp_present=outbox.is_resp_present & src_alive,
         **pv,
     )
+    clients = st.clients
+    if cfg.clients_u32:
+        # Client transition on the POST-tick (post-freeze) state: acks
+        # come from the group's applied dedup tables, next tick's
+        # submit pulses are raised (clients/workload.py).
+        from raft_tpu.clients import workload
+        tmax = workload.table_max(new_nodes.session_seq, node_axis=1)
+        clients = workload.client_update(
+            cfg, clients, tmax, st.group_id[:, None],
+            jnp.arange(cfg.client_slots, dtype=I32)[None, :], t)
     return State(nodes=new_nodes, mailbox=outbox, alive_prev=alive_now,
-                 group_id=st.group_id)
+                 group_id=st.group_id, clients=clients)

@@ -104,5 +104,17 @@ def transfer_target(seed, g, epoch, k: int):
             % jnp.uint32(k)).astype(jnp.int32)
 
 
+def client_arrives(seed, g, sid, tick, clients_u32: int):
+    if clients_u32 == 0:
+        return jnp.zeros(_full_shape(g, sid, tick), jnp.bool_)
+    return hash_u32(seed, _r.TAG_CLIENT_ARRIVAL, g, sid, tick) \
+        < jnp.uint32(clients_u32)
+
+
+def client_val(seed, g, sid, seq):
+    return (hash_u32(seed, _r.TAG_CLIENT_VAL, g, sid, seq)
+            & jnp.uint32(0x3FF)).astype(jnp.int32)
+
+
 def digest_update(digest, index, payload):
     return mix32(_u32(digest) * _GOLD + mix32(_u32(index) * _GOLD + _u32(payload)))

@@ -28,6 +28,8 @@ TAG_RECONFIG = 7       # per-group per-epoch membership-change proposal?
 TAG_RECONFIG_NODE = 8  # which node's membership the proposal toggles
 TAG_TRANSFER = 9       # per-group per-epoch leadership-transfer attempt?
 TAG_TRANSFER_NODE = 10  # which node the transfer hands leadership to
+TAG_CLIENT_ARRIVAL = 11  # per-(group, sid) per-tick client-op arrival?
+TAG_CLIENT_VAL = 12      # the 10-bit value hash of client op (sid, seq)
 
 
 def mix32(x: int) -> int:
@@ -102,6 +104,18 @@ def transfer_fires(seed: int, g: int, epoch: int, transfer_u32: int) -> bool:
 def transfer_target(seed: int, g: int, epoch: int, k: int) -> int:
     """Which node the epoch's transfer attempt hands leadership to."""
     return hash_u32(seed, TAG_TRANSFER_NODE, g, epoch) % k
+
+
+def client_arrives(seed: int, g: int, sid: int, tick: int,
+                   clients_u32: int) -> bool:
+    """Does a new op arrive at (group, sid)'s open-loop client this tick?"""
+    return hash_u32(seed, TAG_CLIENT_ARRIVAL, g, sid, tick) < clients_u32
+
+
+def client_val(seed: int, g: int, sid: int, seq: int) -> int:
+    """10-bit value hash of client op (sid, seq) — a pure function of
+    the op identity, so a RETRY submits the byte-identical payload."""
+    return hash_u32(seed, TAG_CLIENT_VAL, g, sid, seq) & 0x3FF
 
 
 def digest_update(digest: int, index: int, payload: int) -> int:

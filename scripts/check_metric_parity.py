@@ -26,8 +26,13 @@ def check() -> list[str]:
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
+    from raft_tpu.clients.state import CLIENT_LEAVES, ClientState, \
+        clients_init
+    from raft_tpu.config import RaftConfig
     from raft_tpu.obs.recorder import FLIGHT_LEAVES, RING, Flight, flight_init
-    from raft_tpu.sim.pkernel import KMetrics, METRIC_LEAVES, N_METRIC_LEAVES
+    from raft_tpu.sim.pkernel import (CLIENT_METRIC_LEAVES, KMetrics,
+                                      METRIC_LEAVES, N_METRIC_LEAVES,
+                                      _active_metric_leaves)
     from raft_tpu.sim.run import HIST_SIZE, Metrics, metrics_init
 
     problems = []
@@ -42,21 +47,56 @@ def check() -> list[str]:
     if Flight._fields != FLIGHT_LEAVES:
         problems.append(f"Flight fields {Flight._fields} != wire order "
                         f"FLIGHT_LEAVES {FLIGHT_LEAVES}")
+    if ClientState._fields != CLIENT_LEAVES:
+        problems.append(f"ClientState fields {ClientState._fields} != wire "
+                        f"order CLIENT_LEAVES {CLIENT_LEAVES}")
+
+    # The active wire subset must drop EXACTLY the client lanes when
+    # clients are off, and be the full tuple when on.
+    cfg_off = RaftConfig(seed=1)
+    cfg_on = RaftConfig(seed=1, sessions=True, cmds_per_tick=0,
+                        client_rate=0.2, client_slots=3)
+    if _active_metric_leaves(cfg_on) != METRIC_LEAVES:
+        problems.append("clients-on active metric leaves != METRIC_LEAVES")
+    want_off = tuple(n for n in METRIC_LEAVES
+                     if n not in CLIENT_METRIC_LEAVES)
+    if _active_metric_leaves(cfg_off) != want_off:
+        problems.append(f"clients-off active metric leaves "
+                        f"{_active_metric_leaves(cfg_off)} != {want_off}")
 
     g = 4
-    m = metrics_init(g)
     # The kernel wire is i32 lanes: every metric leaf must be i32, with
-    # the shapes kinit folds ([G] per-group, scalar, or [H] histogram).
+    # the shapes kinit folds ([G] per-group, scalar, or [H] histogram);
+    # client lanes None with clients off, concrete with clients on.
     want_shape = {"committed": (g,), "leaderless": (g,), "elections": (),
-                  "hist": (HIST_SIZE,), "max_latency": (), "safety": (g,)}
-    for name in Metrics._fields:
-        leaf = getattr(m, name)
+                  "hist": (HIST_SIZE,), "max_latency": (), "safety": (g,),
+                  "client_acked": (g,), "client_retries": (g,),
+                  "client_hist": (HIST_SIZE,), "client_max_lat": ()}
+    for clients in (False, True):
+        m = metrics_init(g, clients=clients)
+        for name in Metrics._fields:
+            leaf = getattr(m, name)
+            if leaf is None:
+                if clients or name not in CLIENT_METRIC_LEAVES:
+                    problems.append(f"Metrics.{name} unexpectedly None "
+                                    f"(clients={clients})")
+                continue
+            if not clients and name in CLIENT_METRIC_LEAVES:
+                problems.append(f"Metrics.{name} present with clients off")
+            if leaf.dtype != jnp.int32:
+                problems.append(f"Metrics.{name} dtype {leaf.dtype} != "
+                                f"int32 (kernel wire lanes are i32)")
+            if leaf.shape != want_shape[name]:
+                problems.append(f"Metrics.{name} shape {leaf.shape} != "
+                                f"{want_shape[name]}")
+    cs = clients_init(cfg_on, g)
+    for name in ClientState._fields:
+        leaf = getattr(cs, name)
         if leaf.dtype != jnp.int32:
-            problems.append(f"Metrics.{name} dtype {leaf.dtype} != int32 "
-                            f"(kernel wire lanes are i32)")
-        if leaf.shape != want_shape[name]:
-            problems.append(f"Metrics.{name} shape {leaf.shape} != "
-                            f"{want_shape[name]}")
+            problems.append(f"ClientState.{name} dtype {leaf.dtype} != i32")
+        if leaf.shape != (g, cfg_on.client_slots):
+            problems.append(f"ClientState.{name} shape {leaf.shape} != "
+                            f"{(g, cfg_on.client_slots)}")
     f = flight_init(g)
     for name in Flight._fields:
         leaf = getattr(f, name)
@@ -74,8 +114,9 @@ def main() -> int:
         for p in problems:
             print(f"METRIC PARITY DRIFT: {p}")
         return 1
-    print("metric parity ok: Metrics == KMetrics == METRIC_LEAVES; "
-          "Flight == FLIGHT_LEAVES; all leaves i32 at wire shapes")
+    print("metric parity ok: Metrics == KMetrics == METRIC_LEAVES "
+          "(client lanes gated); Flight == FLIGHT_LEAVES; "
+          "ClientState == CLIENT_LEAVES; all leaves i32 at wire shapes")
     return 0
 
 

@@ -73,11 +73,20 @@ def _check_pairwise(rows):
                 f"{FACTORS[j]} only hit {sorted(seen)}")
 
 
-def sweep_configs(base_seed: int):
+def sweep_configs(base_seed: int, clients: bool = False):
     """The 6 sweep universes: k in {3,4,5} and L in {16,32} cycle
-    across the covering-array rows, seeds derived from base_seed."""
+    across the covering-array rows, seeds derived from base_seed. With
+    `clients` (the `--clients` axis, ISSUE r09) every universe swaps
+    the scheduled fire-hose for open-loop exactly-once session traffic
+    (sessions=True, cmds_per_tick=0, retrying clients) — the same
+    pairwise feature x fault matrix, driven by duplicate-risk client
+    ops through BOTH engines."""
     ks = (3, 4, 5)
     ls = (16, 32)
+    cl = {}
+    if clients:
+        cl = dict(sessions=True, cmds_per_tick=0, client_rate=0.25,
+                  client_slots=3, client_retry_backoff=6)
     for n, row in enumerate(ROWS):
         prevote, reconfig, transfer, reads, partition = row
         yield RaftConfig(
@@ -90,6 +99,7 @@ def sweep_configs(base_seed: int):
             read_every=4 if reads else 0,
             partition_prob=0.2 if partition else 0.0, partition_epoch=16,
             crash_prob=0.15, crash_epoch=24, drop_prob=0.04,
+            **cl,
         )
 
 
@@ -104,7 +114,8 @@ def run_universe(cfg: RaftConfig, n_groups: int, ticks: int,
     comparison also certifies that sharding is invisible."""
     t0 = time.perf_counter()
     st0 = sim.init(cfg, n_groups=n_groups)
-    stx, mx = run(cfg, st0, ticks, 0, metrics_init(n_groups))
+    stx, mx = run(cfg, st0, ticks, 0,
+                  metrics_init(n_groups, clients=cfg.clients_u32 != 0))
     if devices > 1:
         from raft_tpu import parallel
         from raft_tpu.parallel import kmesh
@@ -118,11 +129,23 @@ def run_universe(cfg: RaftConfig, n_groups: int, ticks: int,
         mx, mp, names=list(type(mx)._fields))
     unsafe = unsafe_groups(mx)
     dt = time.perf_counter() - t0
-    if s_ok and m_ok:
-        return (True, "bit-identical (state + metrics incl. histogram "
-                "+ safety bit)", dt, unsafe)
-    return (False, f"state: {s_why or 'ok'}; metrics: {m_why or 'ok'}",
-            dt, unsafe)
+    eo_ok, eo_why = True, ""
+    if cfg.clients_u32:
+        # Exactly-once endpoint accounting (clients/workload.py) on top
+        # of the per-tick fold already latched into `unsafe`: a
+        # double-apply shows up as rc != 0 either way.
+        from raft_tpu.clients import exactly_once_report
+        eo_ok, eo_why = exactly_once_report(cfg, stx, mx)
+    if s_ok and m_ok and eo_ok:
+        detail = "bit-identical (state + metrics incl. histogram + safety bit)"
+        if cfg.clients_u32:
+            import numpy as np
+            detail += (f"; {eo_why}; "
+                       f"{int(np.asarray(stx.clients.retries).sum())} "
+                       f"duplicate-risk retries")
+        return (True, detail, dt, unsafe)
+    return (False, f"state: {s_why or 'ok'}; metrics: {m_why or 'ok'}; "
+            f"exactly-once: {eo_why or 'ok'}", dt, unsafe)
 
 
 def _reexec_with_host_devices(n_devices: int) -> int:
@@ -152,6 +175,11 @@ def main():
                     help="shard the kernel over this many devices "
                     "(re-execs onto a virtual CPU platform if the box "
                     "has fewer)")
+    ap.add_argument("--clients", action="store_true",
+                    help="drive every universe with open-loop "
+                    "exactly-once session traffic instead of the "
+                    "scheduled fire-hose (sessions x fault matrix; "
+                    "exit nonzero on divergence or double-apply)")
     args = ap.parse_args()
     _check_pairwise(ROWS)
 
@@ -184,9 +212,11 @@ def main():
         return 2
 
     failures = violations = swept = 0
-    for n, cfg in enumerate(sweep_configs(args.seed)):
+    for n, cfg in enumerate(sweep_configs(args.seed, args.clients)):
         feats = "+".join(f for f, on in zip(FACTORS, ROWS[n]) if on) \
             or "faults-only"
+        if args.clients:
+            feats += "+clients"
         # Sweep universes carry no flight ring: budget the flight-off
         # model, matching run_universe's flightless prun/prun_sharded.
         if not pkernel.supported(cfg, args.groups, args.devices,

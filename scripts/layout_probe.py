@@ -126,6 +126,31 @@ def bytes_per_group_report(cfg=None):
         print(f"  x{d} devices (kernel, flight on): "
               f"{pkernel.hbm_ceiling_groups(cfg, n_devices=d):>9,d} groups")
 
+    # Client-traffic delta (DESIGN.md §10): the headline config with
+    # the bench client-SLO segment's workload knobs on.
+    import dataclasses
+    ccfg = dataclasses.replace(cfg, sessions=True, cmds_per_tick=0,
+                               client_rate=0.2, client_slots=4,
+                               client_retry_backoff=8)
+    from raft_tpu.clients.state import CLIENT_LEAVES
+    cwire = 4 * pkernel.wire_words_per_group(ccfg, with_flight=True)
+    s = ccfg.client_slots
+    n_cl = len(CLIENT_LEAVES)
+    parts = {
+        "session tables (2 x [K, S] i32)": 2 * cfg.k * s * 4,
+        "IS mailbox session payload ([K, K, S])": cfg.k * cfg.k * s * 4,
+        f"client state ({s} slots x {n_cl} leaves)": n_cl * s * 4,
+        "client SLO lanes (acked/retries/max_lat)": 3 * 4,
+        "client ack-latency histogram rows": 4 * pkernel.HIST_SIZE,
+    }
+    print(f"client traffic delta (slots={s}, DESIGN.md §10): "
+          f"wire {cwire} B/group (+{cwire - wire} B):")
+    for name, b in parts.items():
+        print(f"  {b:6d} B  {name}")
+    print(f"  client-universe single-chip G ceiling (flight on): "
+          f"{pkernel.hbm_ceiling_groups(ccfg):>9,d} groups "
+          f"(vs {pkernel.hbm_ceiling_groups(cfg):,d} without clients)")
+
 
 def main():
     ap = argparse.ArgumentParser()

@@ -151,16 +151,16 @@ def _time_cell(cfg, n_groups, ticks, mesh):
                                     mesh)
     t0 = time.perf_counter()
     leaves = kmesh.kstep_sharded(cfg, leaves, 0, CHUNK, mesh)
-    pkernel.kcommitted(leaves, g)
+    pkernel.kcommitted(cfg, leaves, g)
     leaves = kmesh.kstep_sharded(cfg, leaves, CHUNK, CHUNK, mesh)
-    base = pkernel.kcommitted(leaves, g)
+    base = pkernel.kcommitted(cfg, leaves, g)
     warmup_s = time.perf_counter() - t0
     n_chunks = max(1, ticks // CHUNK)
     start = time.perf_counter()
     for c in range(n_chunks):
         leaves = kmesh.kstep_sharded(cfg, leaves, (c + 2) * CHUNK, CHUNK,
                                      mesh)
-    rounds = pkernel.kcommitted(leaves, g) - base   # fetch closes the timer
+    rounds = pkernel.kcommitted(cfg, leaves, g) - base   # fetch closes the timer
     elapsed = time.perf_counter() - start
     _, met = pkernel.kfinish(cfg, leaves, g)
     from raft_tpu.sim.run import unsafe_groups

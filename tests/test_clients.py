@@ -1,0 +1,218 @@
+"""Client traffic subsystem (DESIGN.md §10): the oracle-vs-batched
+session differential, host/jax workload parity, the kernel bit-parity
+gate over the session-table + client-state leaves, the exactly-once
+invariant, and the checkpoint round trip.
+
+Every JAX test here simulates `clients.clients_64_cfg()` at 48 or 120
+ticks — ONE clients-on tick/kernel program per shape, shared with the
+compile cache (tests/conftest.py) like kmesh.faulted_64_cfg's family.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from conftest import trees_equal as _trees_equal
+from raft_tpu import config as C
+from raft_tpu import sim
+from raft_tpu.clients import (HostClients, clients_64_cfg,
+                              exactly_once_report, workload_params)
+from raft_tpu.core.cluster import Cluster
+from raft_tpu.sim import check
+from raft_tpu.sim.run import (metrics_init, run, total_client_ops,
+                              total_client_retries, unsafe_groups)
+from raft_tpu.utils import rng
+
+CFG = clients_64_cfg()
+TICKS = 120
+
+
+def _run_cfg(ticks=TICKS):
+    return run(CFG, sim.init(CFG), ticks)
+
+
+def test_oracle_vs_batched_session_differential():
+    """THE satellite gate (ISSUE r09): the CPU-oracle session machinery
+    (core/cluster.py + HostClients) and the batched dedup fold run the
+    SAME retrying open-loop schedule on the faulted 64-group universe
+    and must agree on every dedup decision — per-node (sid -> seq)
+    tables, digests (which fold only effective ops), applied counts —
+    and on the client-side state (done/backlog/retries/inflight).
+    Asserts the differential is not vacuous: duplicates were actually
+    submitted and deduped."""
+    st, m = _run_cfg()
+    table = np.asarray(st.nodes.session_seq)       # [G, K, S]
+    digest = np.asarray(st.nodes.digest)
+    applied = np.asarray(st.nodes.applied)
+    cl = st.clients
+    for g in range(CFG.n_groups):
+        c = Cluster(CFG, group=g)
+        c.run(TICKS)
+        for i, n in enumerate(c.nodes):
+            want = [n.sessions.get(s, -1) for s in range(CFG.client_slots)]
+            assert list(table[g, i]) == want, (g, i)
+            assert int(digest[g, i]) == n.digest, (g, i)
+            assert int(applied[g, i]) == n.applied, (g, i)
+        hc = c.clients
+        assert list(np.asarray(cl.done)[g]) == hc.done, g
+        assert list(np.asarray(cl.backlog)[g]) == hc.backlog, g
+        assert list(np.asarray(cl.retries)[g]) == hc.retries, g
+        assert list(np.asarray(cl.inflight)[g]) == hc.inflight, g
+    # Not vacuous: the fault mix forced ambiguous-failure retries, ops
+    # completed, and the per-tick exactly-once fold stayed clean.
+    assert total_client_retries(m) > 0
+    assert total_client_ops(m) > 0
+    assert unsafe_groups(m) == 0
+    ok, why = exactly_once_report(CFG, st, m)
+    assert ok, why
+
+
+def test_client_kernel_bit_identical():
+    """The Pallas engine with the full client subsystem — session
+    tables in k-state, IS session payload on the wire, in-kernel
+    client transition, SLO metric lanes, exactly-once safety fold —
+    ends bit-identical to the XLA path on full State AND full Metrics
+    (interpret mode; same 48-tick shape as the kmesh family)."""
+    from raft_tpu.sim import pkernel
+
+    st0 = sim.init(CFG)
+    stx, mx = run(CFG, st0, 48)
+    stp, mp = pkernel.prun(CFG, st0, 48, interpret=True)
+    assert _trees_equal(stx, stp), "kernel State diverged (client leaves?)"
+    assert _trees_equal(mx, mp), "kernel Metrics diverged (client lanes?)"
+    assert total_client_ops(mx) > 0, "no acked ops - differential vacuous"
+    # The wire-lane readers bench drives are pinned to the XLA totals
+    # (kinit loads the SLO lanes pass-through; a wire-order drift here
+    # would feed bench a wrong counter).
+    leaves, g = pkernel.kinit(CFG, stx, mx)
+    assert pkernel.kacked(CFG, leaves, g) == total_client_ops(mx)
+    assert pkernel.kretries(CFG, leaves, g) == total_client_retries(mx)
+
+
+def test_client_wire_model_pins_exact():
+    """The HBM byte model counts the client wire leaves (session
+    tables, IS mailbox payload, client state, SLO lanes + second
+    histogram) EXACTLY — the r08 pin extended over the r09 leaves."""
+    from raft_tpu.obs import flight_init
+    from raft_tpu.sim import pkernel
+
+    st0 = sim.init(CFG)
+    for flight in (None, flight_init(CFG.n_groups)):
+        leaves, _ = pkernel.kinit(CFG, st0, flight=flight)
+        actual = sum(int(np.prod(a.shape)) for a in leaves) // pkernel.GB
+        model = pkernel.wire_words_per_group(
+            CFG, with_flight=flight is not None)
+        assert actual == model, (
+            f"wire model {model} words/group != real leaves {actual} "
+            f"(flight={'on' if flight is not None else 'off'})")
+    # And the clients-on wire strictly exceeds the clients-off wire of
+    # the same shape (the documented bytes/group delta is real).
+    import dataclasses
+    off = dataclasses.replace(CFG, client_rate=0.0, sessions=False)
+    assert pkernel.wire_words_per_group(CFG) \
+        > pkernel.wire_words_per_group(off)
+
+
+def test_host_workload_mirror_is_exact():
+    """HostClients (the oracle driver) mirrors the jnp transition bit
+    for bit through an adversarial synthetic table-witness schedule —
+    acks, arrivals, retry backoff, backlog, latency events."""
+    import jax.numpy as jnp
+    from raft_tpu.clients import client_update, clients_init, \
+        submit_payloads
+
+    cfg = CFG
+    g = 0
+    cs = clients_init(cfg, 1)
+    host = HostClients(cfg, g)
+    tmax_host = [-1] * cfg.client_slots
+    gcol = jnp.asarray([[g]], jnp.int32)
+    scol = jnp.arange(cfg.client_slots, dtype=jnp.int32)[None, :]
+    for t in range(160):
+        # Adversarial witness: acks arrive only when the hash says so,
+        # so ops straddle several backoff windows and retry.
+        for s in range(cfg.client_slots):
+            if host.inflight[s] and rng.hash_u32(7, g, s, t) % 5 == 0:
+                tmax_host[s] = max(tmax_host[s], host.done[s])
+        tm = jnp.asarray([tmax_host], jnp.int32)
+        cs = client_update(cfg, cs, tm, gcol, scol, t)
+        host.observe(tmax_host, t)
+        for f in cs._fields:
+            assert list(np.asarray(getattr(cs, f))[0]) \
+                == list(getattr(host, f)), (f, t)
+        sub, pay = submit_payloads(cfg, cs, gcol, scol)
+        assert list(np.asarray(sub)[0]) == host.submit, t
+        want = []
+        for s in range(cfg.client_slots):
+            want.append(C.session_payload(
+                s, host.done[s], rng.client_val(cfg.seed, g, s,
+                                                host.done[s])))
+        assert list(np.asarray(pay)[0]) == want, t
+    assert sum(host.retries) > 0 and sum(host.done) > 0
+
+
+def test_client_safety_latches_double_apply():
+    """The exactly-once safety clause trips on synthetic corruption:
+    a table seq above the issued frontier (phantom apply) and a
+    divergent dedup decision between equally-applied nodes both drop
+    the per-tick bit, and the AND latches."""
+    from raft_tpu.sim.run import metrics_update
+
+    st, m = _run_cfg(48)
+    assert unsafe_groups(m) == 0
+    # Phantom apply: node 0's sid-0 entry jumps past done.
+    bad = st._replace(nodes=st.nodes._replace(
+        session_seq=st.nodes.session_seq.at[:, 0, 0].set(
+            st.clients.done[:, 0] + 7)))
+    m2 = metrics_update(m, bad, CFG.log_cap)
+    assert unsafe_groups(m2) == CFG.n_groups
+    assert not bool(np.all(np.asarray(check.client_safety(bad))))
+    # Divergent dedup decision: two nodes with forced-equal applied
+    # prefixes disagree on a table entry.
+    nodes = st.nodes._replace(
+        applied=st.nodes.applied.at[:, 1].set(st.nodes.applied[:, 0]),
+        commit=st.nodes.commit.at[:, 1].set(st.nodes.applied[:, 0]),
+        session_seq=st.nodes.session_seq.at[:, 1, 0].set(
+            st.nodes.session_seq[:, 0, 0] - 1))
+    m3 = metrics_update(m, st._replace(nodes=nodes), CFG.log_cap)
+    assert unsafe_groups(m3) == CFG.n_groups
+    # The AND latches: a later clean tick cannot clear it.
+    m4 = metrics_update(m2, st, CFG.log_cap)
+    assert unsafe_groups(m4) == CFG.n_groups
+
+
+def test_client_chunk_boundaries_invisible():
+    """Two chunked runs == one unbroken run on state AND client metric
+    lanes (idempotent acked/retry recompute; event-folded histogram).
+    24-tick chunks share the checkpoint test's compiled program."""
+    st0 = sim.init(CFG)
+    st_a, m_a = run(CFG, st0, 48)
+    st_b, m_b = run(CFG, st0, 24)
+    st_b, m_b = run(CFG, st_b, 24, 24, m_b)
+    assert _trees_equal(st_a, st_b)
+    assert _trees_equal(m_a, m_b)
+
+
+def test_checkpoint_roundtrip_with_clients(tmp_path):
+    """A clients-on checkpoint round-trips exactly (session tables,
+    client state, SLO lanes) and the resumed run continues
+    bit-identically."""
+    from raft_tpu.sim import checkpoint
+
+    st, m = _run_cfg(24)
+    path = tmp_path / "clients.npz"
+    checkpoint.save(path, st, 24, m, cfg=CFG)
+    st2, t2, m2 = checkpoint.load(path, cfg=CFG)
+    assert t2 == 24
+    assert _trees_equal(st, st2) and _trees_equal(m, m2)
+    a, ma = run(CFG, st, 24, 24, m)
+    b, mb = run(CFG, st2, 24, t2, m2)
+    assert _trees_equal(a, b) and _trees_equal(ma, mb)
+
+
+def test_workload_params_cover_the_knobs():
+    p = workload_params(CFG)
+    assert p["rate"] == CFG.client_rate
+    assert p["slots"] == CFG.client_slots
+    assert p["retry_backoff"] == CFG.client_retry_backoff
+    assert p["seed"] == CFG.seed and "retry_policy" in p

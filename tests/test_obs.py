@@ -140,14 +140,39 @@ def test_safety_bit_latches_violations():
 
 
 def test_metric_parity_script():
-    """The static Metrics/KMetrics/Flight parity gate runs clean —
-    tier-1 coverage for scripts/check_metric_parity.py."""
+    """The static Metrics/KMetrics/Flight/ClientState parity gate runs
+    clean — tier-1 coverage for scripts/check_metric_parity.py,
+    client-metric lanes included (r09)."""
     script = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "scripts", "check_metric_parity.py")
     proc = subprocess.run([sys.executable, script], capture_output=True,
                           text=True)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "metric parity ok" in proc.stdout
+
+
+def test_client_metric_lanes_statically_gated():
+    """Metrics grows the client-SLO lanes ONLY under clients=True
+    (r09): the clients-off pytree — and hence every pre-r09 compiled
+    program, checkpoint, and gate surface — is unchanged, and lane
+    drift between the engines' wire orders stays rc != 0 via the
+    parity script above."""
+    from raft_tpu.sim.pkernel import (CLIENT_METRIC_LEAVES, KMetrics,
+                                      METRIC_LEAVES)
+    from raft_tpu.sim.run import Metrics
+
+    off = metrics_init(4)
+    on = metrics_init(4, clients=True)
+    for name in CLIENT_METRIC_LEAVES:
+        assert getattr(off, name) is None
+        assert getattr(on, name) is not None
+    # Field-name parity across the three surfaces.
+    assert set(Metrics._fields) == set(METRIC_LEAVES) \
+        == set(KMetrics._fields)
+    # A clients-off Metrics flattens to the pre-r09 leaf count.
+    import jax
+    assert len(jax.tree.leaves(off)) == 6
+    assert len(jax.tree.leaves(on)) == 10
 
 
 def test_manifest_roundtrip(tmp_path):

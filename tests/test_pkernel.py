@@ -192,8 +192,8 @@ def test_kstate_round_trip():
     leaves, g = pkernel.kinit(cfg, st0)
     st1, met = pkernel.kfinish(cfg, leaves, g)
     assert trees_equal(st0, st1)
-    assert pkernel.kcommitted(leaves, g) == 0
-    assert pkernel.kelections(leaves, g) == 0
+    assert pkernel.kcommitted(cfg, leaves, g) == 0
+    assert pkernel.kelections(cfg, leaves, g) == 0
     assert pkernel.kflight(cfg, leaves, g) is None
     fleaves, g = pkernel.kinit(cfg, st0, flight=flight_init(10))
     st2, _ = pkernel.kfinish(cfg, fleaves, g)
