@@ -717,6 +717,13 @@ def main():
                     help="override the throughput-run group count")
     args = ap.parse_args()
 
+    # Pre-flight engine-contract audit (DESIGN.md §11): eval_shape
+    # traces + AST parses only — no device programs. A drifted wire
+    # registry / byte model / checkpoint contract aborts the run here,
+    # so no benchmark number is ever published off a drifted layout.
+    from raft_tpu import analysis
+    analysis.startup_audit(level="static", log=log)
+
     dev = jax.devices()[0]
     log(f"platform: {dev.platform} ({dev.device_kind}), "
         f"{len(jax.devices())} device(s)")

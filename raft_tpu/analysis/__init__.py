@@ -1,0 +1,90 @@
+"""Static engine-contract auditor (DESIGN.md §11).
+
+Proves — without a TPU and without executing a tick — that the three
+engines (CPU oracle, XLA scan, Pallas kernel), the kernel wire model,
+and the checkpoint format agree:
+
+- `contracts` — leaf-contract passes: pytree definitions vs the wire
+  registries, the `kleaf_spec` shard rule, checkpoint coverage +
+  pre-r07/r09 backfills, the cfg-gating table, rng/jrng parity.
+- `bytemodel` — bytes/group DERIVED from dtype x shape (eval_shape),
+  reconciled exactly against the hand-pinned wire model
+  (`pkernel.wire_words_per_group`: 8,308 B clients-off / 11,056 B
+  clients-on), with the i32-widened-bool waste named per leaf.
+- `lint` — AST purity/determinism rules over sim/step.py,
+  sim/pkernel.py, clients/workload.py (tagged randomness only, no
+  Python branching on traced values, elementwise-only workload
+  transition).
+
+Entry points: `audit_report()` (machine-readable dict),
+`audit_problems()` (flat strings), `startup_audit()` (raise on drift —
+bench.py / kernel_sweep.py call it so no number is ever published off
+a drifted layout), and the `raft-tpu-audit` console script /
+`scripts/static_audit.py` (rc != 0 on any drift).
+"""
+
+from __future__ import annotations
+
+from raft_tpu.analysis import bytemodel, contracts, lint
+
+__all__ = ["audit_report", "audit_problems", "startup_audit",
+           "bytemodel", "contracts", "lint"]
+
+
+def audit_report(level: str = "full") -> dict:
+    """Run every pass; return the full machine-readable report.
+
+    `level="static"` skips the behavioral checkpoint round-trips (the
+    only pass that materializes concrete host arrays) — the cheap
+    import-time form bench/kernel_sweep gate their startup on;
+    `level="full"` is the CI/script form.
+    """
+    if level not in ("static", "full"):
+        raise ValueError(f"unknown audit level {level!r}")
+    problems = contracts.contract_problems(
+        include_behavioral=(level == "full"))
+    # One derivation per (config, flight) point — the flight-on models
+    # double as the report's byte_model block (each derivation is
+    # several eval_shape traces; don't pay them twice per startup).
+    byte_models = {}
+    for label, cfg in (("headline", bytemodel.headline_cfg()),
+                       ("clients", bytemodel.clients_cfg())):
+        for wf in (True, False):
+            model = bytemodel.derived_wire_model(cfg, with_flight=wf)
+            problems += [
+                f"byte model [{label}, flight={'on' if wf else 'off'}]: {p}"
+                for p in model["problems"]]
+            if wf:
+                byte_models[label] = model
+    findings = lint.lint_default()
+    return {
+        "level": level,
+        "ok": not problems and not findings,
+        "problems": problems,
+        "lint": [f.as_dict() for f in findings],
+        "byte_model": byte_models,
+    }
+
+
+def audit_problems(level: str = "full") -> list[str]:
+    """Every problem as one flat list of strings (lint findings
+    rendered file:line)."""
+    rep = audit_report(level=level)
+    return rep["problems"] + [str(lint.Finding(**f)) for f in rep["lint"]]
+
+
+def startup_audit(level: str = "static", log=None) -> None:
+    """The cheap pre-flight gate for benchmark drivers: raise
+    RuntimeError listing every contract drift, so no benchmark number
+    is ever published off a drifted layout. Call before the first
+    timed segment; costs a few eval_shape traces and three AST parses
+    (no device programs, no compiles)."""
+    probs = audit_problems(level=level)
+    if probs:
+        raise RuntimeError(
+            "static engine-contract audit failed — refusing to run on a "
+            "drifted layout (scripts/static_audit.py for the report):\n  "
+            + "\n  ".join(probs))
+    if log is not None:
+        log(f"static audit ok ({level}): contracts, byte model, and "
+            f"purity lint all clean")

@@ -1,0 +1,295 @@
+"""Derived wire-byte model: bytes/group computed from real dtypes and
+shapes, reconciled against the hand-pinned model (DESIGN.md §11).
+
+Every number here is DERIVED, never pinned: the State / Metrics /
+Flight pytrees are traced with `jax.eval_shape` (no device buffers, no
+tick executed — the whole pass runs on a box with no accelerator), each
+leaf's wire contribution is computed from its dtype x shape, and the
+totals are reconciled against THREE independent accountings:
+
+1. the per-leaf walk over the State pytree + metric lanes + flight
+   rings (this module's own sum);
+2. the real `pkernel.kinit` output leaves, again under `eval_shape`
+   (each wire leaf's element count divided by the padded group count);
+3. the hand-maintained `pkernel.wire_words_per_group` model that
+   `supported()` / `hbm_bytes` / the multichip sweep budget against.
+
+Any disagreement is contract drift and fails the audit — this is the
+machine that would have caught r08's alive_prev k-words bug (8,308 vs
+8,292 B/group) before a reviewer did.
+
+The model also names every i32-WIDENED bool leaf: a State bool costs
+1 byte on the XLA path but rides the kernel wire as a 4-byte i32 lane
+(Mosaic cannot transport i1 vectors — sim/pkernel.py module
+docstring), so each bool word carries 3 bytes of pure widening waste
+(~690 B/group at the headline config, the "~700 B" of the r08 probe).
+The waste is structural until the packed-layout work (ROADMAP item 2)
+lands; the report is its measured starting point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from raft_tpu.config import RaftConfig
+
+# Group count used for every eval_shape trace. Must differ from
+# HIST_SIZE and from every per-node axis so shapes discriminate leaf
+# roles by value, and must be >= 2 so a [G] lane cannot be mistaken
+# for a scalar.
+_G0 = 2
+
+
+def headline_cfg() -> RaftConfig:
+    """The bench headline universe (k=5, L=32, clients off) whose wire
+    model is pinned at 8,308 B/group (DESIGN.md §9)."""
+    return RaftConfig(seed=42)
+
+
+def clients_cfg() -> RaftConfig:
+    """The bench client-SLO universe (headline + 4 retrying sessions)
+    whose wire model is pinned at 11,056 B/group (DESIGN.md §10)."""
+    return dataclasses.replace(headline_cfg(), sessions=True,
+                               cmds_per_tick=0, client_rate=0.2,
+                               client_slots=4, client_retry_backoff=8)
+
+
+# THE pytree-walk/key-naming rule is checkpoint's (its npz keys are
+# one of the audited surfaces) — re-exported here so every auditor
+# pass names leaves identically to the checkpoint format by
+# construction, not by parallel implementation.
+from raft_tpu.sim.checkpoint import iter_named_leaves  # noqa: F401,E402
+
+
+def _specs(cfg: RaftConfig, with_flight: bool):
+    """(state, metrics, flight, kinit-leaves) as ShapeDtypeStruct
+    pytrees — pure abstract tracing, zero device buffers."""
+    import jax
+
+    from raft_tpu import sim
+    from raft_tpu.obs.recorder import flight_init
+    from raft_tpu.sim import pkernel
+    from raft_tpu.sim.run import metrics_init
+
+    st = jax.eval_shape(lambda: sim.init(cfg, n_groups=_G0))
+    met = jax.eval_shape(
+        lambda: metrics_init(_G0, clients=cfg.clients_u32 != 0))
+    fl = jax.eval_shape(lambda: flight_init(_G0)) if with_flight else None
+    if with_flight:
+        kleaves = jax.eval_shape(
+            lambda s, f: pkernel.kinit(cfg, s, None, f)[0], st, fl)
+    else:
+        kleaves = jax.eval_shape(
+            lambda s: pkernel.kinit(cfg, s, None, None)[0], st)
+    return st, met, fl, kleaves
+
+
+def derived_wire_model(cfg: RaftConfig, with_flight: bool = True) -> dict:
+    """The machine-readable bytes/group report. Keys:
+
+    - ``leaves``: one row per wire contribution (name, kind, dtype,
+      per-group shape, wire words, wire bytes, native bytes, widened);
+    - ``wire_bytes_derived`` / ``wire_bytes_pinned`` and the two
+      cross-check sums (`state_words_*`, `kinit_words_per_group`);
+    - ``widening``: the i32-widened bool leaves and their waste;
+    - ``hbm``: the ceiling implied by the derived bytes, plus the
+      supported()-boundary consistency bits;
+    - ``problems``: every reconciliation failure, as strings (empty ==
+      the derived and pinned models agree exactly).
+    """
+    import numpy as np
+
+    from raft_tpu.obs.recorder import FLIGHT_LEAVES, RING
+    from raft_tpu.sim import pkernel
+
+    problems: list[str] = []
+    st, met, fl, kleaves = _specs(cfg, with_flight)
+
+    rows = []
+    state_words = 0
+    for name, leaf in iter_named_leaves(st):
+        shape = tuple(leaf.shape)
+        if not shape or shape[0] != _G0:
+            problems.append(
+                f"state leaf {name}: shape {shape} does not lead with the "
+                f"group axis (G={_G0}) — the wire fold and kleaf_spec both "
+                f"assume it does")
+            continue
+        per_group = shape[1:]
+        words = int(np.prod(per_group, dtype=np.int64)) if per_group else 1
+        itemsize = np.dtype(leaf.dtype).itemsize
+        widened = np.dtype(leaf.dtype) == np.bool_
+        if np.dtype(leaf.dtype).itemsize > 4:
+            problems.append(
+                f"state leaf {name}: dtype {leaf.dtype} is wider than the "
+                f"32-bit wire lane — kinit would silently truncate it")
+        rows.append({
+            "name": name, "kind": "state", "dtype": str(np.dtype(leaf.dtype)),
+            "shape_per_group": list(per_group),
+            "wire_words": words, "wire_bytes": 4 * words,
+            "native_bytes": itemsize * words, "widened_bool": bool(widened),
+        })
+        state_words += words
+
+    # Metric tail: every active non-row leaf is ONE per-group lane on
+    # the wire (scalars like `elections` accumulate per group in-kernel
+    # and reduce at kfinish); row leaves are per-group [H] histogram
+    # rows. Derived from the Metrics leaf shapes, not from the kind
+    # tables, so a new metric lane cannot be silently mis-filed.
+    metric_words = 0
+    per_group_metrics = set()
+    for name in pkernel._active_metric_leaves(cfg):
+        leaf = getattr(met, name)
+        if leaf is None:
+            problems.append(f"metric leaf {name}: active on the wire under "
+                            f"this cfg but None in metrics_init")
+            continue
+        shape = tuple(leaf.shape)
+        if name in pkernel.ROW_METRIC_LEAVES:
+            words = int(shape[0])
+            kind = "metric-row"
+        elif shape == (_G0,):
+            words, kind = 1, "metric-lane"
+            per_group_metrics.add(name)
+        elif shape == ():
+            words, kind = 1, "metric-lane"
+        else:
+            problems.append(f"metric leaf {name}: unclassifiable shape "
+                            f"{shape} (not [G], scalar, or a row leaf)")
+            continue
+        rows.append({
+            "name": f"metrics.{name}", "kind": kind,
+            "dtype": str(np.dtype(leaf.dtype)), "shape_per_group": [],
+            "wire_words": words, "wire_bytes": 4 * words,
+            "native_bytes": 4 * words, "widened_bool": False,
+        })
+        metric_words += words
+
+    flight_words = 0
+    if with_flight:
+        for name in FLIGHT_LEAVES:
+            leaf = getattr(fl, name)
+            if tuple(leaf.shape) != (RING, _G0):
+                problems.append(f"flight leaf {name}: shape "
+                                f"{tuple(leaf.shape)} != ({RING}, G)")
+                continue
+            rows.append({
+                "name": f"flight.{name}", "kind": "flight-ring",
+                "dtype": str(np.dtype(leaf.dtype)), "shape_per_group": [],
+                "wire_words": RING, "wire_bytes": 4 * RING,
+                "native_bytes": 4 * RING, "widened_bool": False,
+            })
+            flight_words += RING
+
+    derived_words = state_words + metric_words + flight_words
+
+    # Cross-check 2: the real kinit output, element-counted. Every wire
+    # leaf is [..., GS, LANE] with GS * LANE == the padded group count.
+    padded = -(-_G0 // pkernel.GB) * pkernel.GB
+    kinit_words = 0
+    for i, leaf in enumerate(kleaves):
+        n = int(np.prod(leaf.shape, dtype=np.int64))
+        if n % padded:
+            problems.append(f"kinit leaf #{i}: element count {n} is not a "
+                            f"multiple of the padded group count {padded}")
+        kinit_words += n // padded
+    n_expected = (pkernel._n_state_leaves(cfg)
+                  + (len(FLIGHT_LEAVES) if with_flight else 0)
+                  + pkernel._n_metric_leaves(cfg))
+    if len(kleaves) != n_expected:
+        problems.append(f"kinit emitted {len(kleaves)} wire leaves; the "
+                        f"registries (_n_state_leaves + flight + "
+                        f"_n_metric_leaves) promise {n_expected}")
+
+    # Cross-check 3: the hand-pinned model supported()/hbm_bytes use.
+    pinned_state = pkernel._state_words_per_group(cfg)
+    pinned_wire = pkernel.wire_words_per_group(cfg, with_flight=with_flight)
+    # state_words here includes only State-pytree leaves; the pinned
+    # _state_words_per_group additionally counts the non-row metric
+    # LANES (its "scalar_lanes" tail) — align the two accountings.
+    lane_words = sum(r["wire_words"] for r in rows
+                     if r["kind"] == "metric-lane")
+    if state_words + lane_words != pinned_state:
+        problems.append(
+            f"derived state words/group {state_words} + {lane_words} metric "
+            f"lanes != pinned pkernel._state_words_per_group {pinned_state}")
+    if derived_words != pinned_wire:
+        problems.append(
+            f"derived wire words/group {derived_words} != pinned "
+            f"pkernel.wire_words_per_group {pinned_wire} "
+            f"(with_flight={with_flight})")
+    if kinit_words != pinned_wire:
+        problems.append(
+            f"real kinit wire words/group {kinit_words} != pinned "
+            f"pkernel.wire_words_per_group {pinned_wire} "
+            f"(with_flight={with_flight})")
+
+    # Checkpoint's name-based resharding rule must cover exactly the
+    # per-group metric lanes (a [G] lane missing from the tuple loads
+    # replicated — wrong under a mesh; a scalar listed there would
+    # shard a replicated value).
+    from raft_tpu.sim.checkpoint import _PER_GROUP_METRICS
+    active_pg = {n for n in per_group_metrics}
+    listed = set(_PER_GROUP_METRICS) & set(pkernel._active_metric_leaves(cfg))
+    if active_pg != listed:
+        problems.append(
+            f"checkpoint._PER_GROUP_METRICS covers {sorted(listed)} of the "
+            f"active metric leaves but the [G]-shaped ones are "
+            f"{sorted(active_pg)}")
+
+    widened = [r for r in rows if r["widened_bool"]]
+    waste = sum(3 * r["wire_words"] for r in widened)
+
+    # HBM-boundary consistency: the published ceiling must be the exact
+    # supported() boundary (whole blocks; one more block must tip it).
+    ceiling = pkernel.hbm_ceiling_groups(cfg, with_flight=with_flight)
+    hbm_ok = (pkernel.supported(cfg, n_groups=ceiling,
+                                with_flight=with_flight)
+              and not pkernel.supported(cfg, n_groups=ceiling + pkernel.GB,
+                                        with_flight=with_flight))
+    if not hbm_ok:
+        problems.append(
+            f"hbm_ceiling_groups {ceiling} is not the exact supported() "
+            f"boundary (with_flight={with_flight})")
+
+    return {
+        "config": {"k": cfg.k, "log_cap": cfg.log_cap,
+                   "max_entries_per_msg": cfg.max_entries_per_msg,
+                   "clients": cfg.clients_u32 != 0,
+                   "client_slots": (cfg.client_slots
+                                    if cfg.clients_u32 else 0),
+                   "prevote": cfg.prevote,
+                   "transfer": cfg.transfer_u32 != 0,
+                   "with_flight": with_flight},
+        "leaves": rows,
+        "state_words_derived": state_words,
+        "kinit_words_per_group": kinit_words,
+        "wire_words_derived": derived_words,
+        "wire_words_pinned": pinned_wire,
+        "wire_bytes_derived": 4 * derived_words,
+        "wire_bytes_pinned": 4 * pinned_wire,
+        "widening": {
+            "leaves": [r["name"] for r in widened],
+            "wire_bytes": sum(4 * r["wire_words"] for r in widened),
+            "native_bytes": sum(r["native_bytes"] for r in widened),
+            "waste_bytes_per_group": waste,
+        },
+        "hbm": {"ceiling_groups": ceiling,
+                "boundary_exact": bool(hbm_ok),
+                "limit_bytes": pkernel.HBM_LIMIT_BYTES},
+        "problems": problems,
+    }
+
+
+def byte_model_problems() -> list[str]:
+    """The audit entry point: derive + reconcile the two configs every
+    published wire number rides on (the 8,308 B/group headline and the
+    11,056 B/group client universe), flight on and off."""
+    out = []
+    for label, cfg in (("headline", headline_cfg()),
+                       ("clients", clients_cfg())):
+        for wf in (True, False):
+            model = derived_wire_model(cfg, with_flight=wf)
+            out.extend(f"byte model [{label}, flight={'on' if wf else 'off'}]"
+                       f": {p}" for p in model["problems"])
+    return out

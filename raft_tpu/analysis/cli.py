@@ -1,0 +1,91 @@
+"""`raft-tpu-audit` console entry / scripts/static_audit.py body.
+
+Exit status IS the verdict: 0 = every contract holds, nonzero = drift
+(each problem printed, naming the leaf and the registry). `--json`
+emits the full machine-readable report (byte model included) for
+tooling; `--inject-drift LEAF` is the self-test hook the synthetic-
+drift tests use to prove the nonzero path end-to-end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    # Static analysis — never let the import initialize a real
+    # accelerator (same guard as the old check_metric_parity.py).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    ap = argparse.ArgumentParser(
+        prog="raft-tpu-audit",
+        description="Static engine-contract auditor: pytrees vs kernel "
+                    "wire registries vs shard rule vs checkpoint format, "
+                    "derived byte model, and the purity lint "
+                    "(DESIGN.md §11). rc != 0 on any drift.")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full machine-readable report")
+    ap.add_argument("--level", choices=("static", "full"), default="full",
+                    help="'static' skips the behavioral checkpoint "
+                         "round-trips (the bench startup form)")
+    ap.add_argument("--bytes", action="store_true",
+                    help="also print the per-leaf derived byte table")
+    ap.add_argument("--inject-drift", metavar="LEAF", default=None,
+                    help="self-test: audit against a PerNode copy that "
+                         "grew this fake leaf (must exit nonzero naming "
+                         "it)")
+    args = ap.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from raft_tpu import analysis
+    from raft_tpu.analysis import contracts, lint
+
+    if args.inject_drift:
+        from raft_tpu.sim.state import PerNode
+        problems = contracts.wire_registry_problems(
+            pernode_fields=PerNode._fields + (args.inject_drift,))
+        for p in problems:
+            print(f"CONTRACT DRIFT: {p}")
+        if not problems:
+            print("SELF-TEST FAILED: injected drift went undetected")
+            return 2
+        return 1
+
+    report = analysis.audit_report(level=args.level)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for p in report["problems"]:
+            print(f"CONTRACT DRIFT: {p}")
+        for f in report["lint"]:
+            print(f"LINT: {lint.Finding(**f)}")
+        if args.bytes:
+            for label, model in report["byte_model"].items():
+                print(f"derived wire model [{label}]: "
+                      f"{model['wire_bytes_derived']} B/group "
+                      f"(pinned {model['wire_bytes_pinned']})")
+                for row in sorted(model["leaves"],
+                                  key=lambda r: -r["wire_words"]):
+                    star = " *widened bool" if row["widened_bool"] else ""
+                    print(f"  {4 * row['wire_words']:6d} B  "
+                          f"{row['name']:34s} {row['dtype']}{star}")
+                w = model["widening"]
+                print(f"  widening waste: {w['waste_bytes_per_group']} "
+                      f"B/group over {len(w['leaves'])} bool leaves")
+        if report["ok"]:
+            hb = report["byte_model"]["headline"]["wire_bytes_derived"]
+            cb = report["byte_model"]["clients"]["wire_bytes_derived"]
+            print(f"static audit ok ({args.level}): contracts + shard rule "
+                  f"+ checkpoint coverage + byte model (headline {hb} "
+                  f"B/group, clients {cb} B/group, derived == pinned) + "
+                  f"purity lint all clean")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

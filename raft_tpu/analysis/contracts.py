@@ -1,0 +1,616 @@
+"""Leaf-contract auditor: proves the pytree definitions, the kernel
+wire registries, the shard rule, and the checkpoint format agree —
+statically, before anything runs (DESIGN.md §11).
+
+The repo's cross-engine contracts are REGISTRIES — tuples of leaf
+names whose order IS the wire order — plus a handful of derived rules
+(the `kleaf_spec` "shard dim -2" rule, `checkpoint._optional_fields`,
+the cfg-gating "clients-off means the leaf is absent on all three
+engines" table). Every pass here compares one registry against the
+ground truth it mirrors, derived from the NamedTuple definitions and
+`jax.eval_shape` traces (no device, no tick), and returns problem
+strings naming the leaf AND the registry that drifted.
+
+Every pass takes its inputs as parameters with the real definitions as
+defaults, so the synthetic-drift tests (tests/test_analysis.py) can
+hand in a State copy with a fake leaf — or a checkpoint module that
+forgot a backfill — and assert the auditor names it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+
+from raft_tpu.config import RaftConfig
+
+# The statically-gated leaf table: gate name -> (mailbox fields,
+# PerNode fields, State fields) that must exist IFF the gate is on —
+# on the XLA pytree (None otherwise), in the kernel registries, and
+# (sessions) on the CPU oracle. This is the one hand-written table the
+# auditor itself carries; everything else is derived. A new gated
+# feature adds a row here and the gating pass then enforces it across
+# all three engines and the checkpoint optional-field set.
+GATED_LEAVES = {
+    "prevote": (("pv_req_present", "pv_req_term", "pv_req_lli",
+                 "pv_req_llt", "pv_resp_present", "pv_resp_term",
+                 "pv_resp_req_term", "pv_resp_granted"), (), ()),
+    "transfer": (("tn_present", "tn_term"), (), ()),
+    "clients": (("is_req_snap_sessions",),
+                ("session_seq", "snap_session_seq"),
+                ("clients",)),
+}
+
+
+def _base_cfg() -> RaftConfig:
+    return RaftConfig(n_groups=2, k=3, seed=3, log_cap=8, compact_every=4)
+
+
+def _gate_cfgs() -> dict:
+    """gate name -> the config that turns exactly that gate on."""
+    base = _base_cfg()
+    return {
+        "prevote": dataclasses.replace(base, prevote=True),
+        "transfer": dataclasses.replace(base, transfer_prob=0.5),
+        "clients": dataclasses.replace(base, sessions=True,
+                                       cmds_per_tick=0, client_rate=0.3,
+                                       client_slots=2),
+    }
+
+
+def _leaf_names(cfg: RaftConfig) -> set:
+    """Dot-path names of the non-None State leaves under `cfg`
+    (eval_shape — abstract, device-free)."""
+    import jax
+
+    from raft_tpu import sim
+    from raft_tpu.analysis.bytemodel import iter_named_leaves
+    st = jax.eval_shape(lambda: sim.init(cfg, n_groups=2))
+    return {name for name, _ in iter_named_leaves(st)}
+
+
+# --------------------------------------------------- metric-surface parity
+
+
+def metric_parity_problems() -> list[str]:
+    """The static Metrics == KMetrics == METRIC_LEAVES / Flight /
+    ClientState parity check — the former scripts/check_metric_parity.py
+    body, now one pass of the auditor (the script is a thin wrapper)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.clients.state import (CLIENT_LEAVES, ClientState,
+                                        clients_init)
+    from raft_tpu.obs.recorder import (FLIGHT_LEAVES, RING, Flight,
+                                       flight_init)
+    from raft_tpu.sim.pkernel import (CLIENT_METRIC_LEAVES, KMetrics,
+                                      METRIC_LEAVES, N_METRIC_LEAVES,
+                                      _active_metric_leaves)
+    from raft_tpu.sim.run import HIST_SIZE, Metrics, metrics_init
+
+    problems = []
+    if KMetrics._fields != METRIC_LEAVES:
+        problems.append(f"KMetrics fields {KMetrics._fields} != wire order "
+                        f"METRIC_LEAVES {METRIC_LEAVES}")
+    if set(Metrics._fields) != set(METRIC_LEAVES):
+        problems.append(f"Metrics fields {sorted(Metrics._fields)} != "
+                        f"METRIC_LEAVES names {sorted(METRIC_LEAVES)}")
+    if N_METRIC_LEAVES != len(METRIC_LEAVES):
+        problems.append("N_METRIC_LEAVES out of sync with METRIC_LEAVES")
+    if Flight._fields != FLIGHT_LEAVES:
+        problems.append(f"Flight fields {Flight._fields} != wire order "
+                        f"FLIGHT_LEAVES {FLIGHT_LEAVES}")
+    if ClientState._fields != CLIENT_LEAVES:
+        problems.append(f"ClientState fields {ClientState._fields} != wire "
+                        f"order CLIENT_LEAVES {CLIENT_LEAVES}")
+
+    # The active wire subset must drop EXACTLY the client lanes when
+    # clients are off, and be the full tuple when on.
+    cfg_off = RaftConfig(seed=1)
+    cfg_on = RaftConfig(seed=1, sessions=True, cmds_per_tick=0,
+                        client_rate=0.2, client_slots=3)
+    if _active_metric_leaves(cfg_on) != METRIC_LEAVES:
+        problems.append("clients-on active metric leaves != METRIC_LEAVES")
+    want_off = tuple(n for n in METRIC_LEAVES
+                     if n not in CLIENT_METRIC_LEAVES)
+    if _active_metric_leaves(cfg_off) != want_off:
+        problems.append(f"clients-off active metric leaves "
+                        f"{_active_metric_leaves(cfg_off)} != {want_off}")
+
+    g = 4
+    # The kernel wire is i32 lanes: every metric leaf must be i32, with
+    # the shapes kinit folds ([G] per-group, scalar, or [H] histogram);
+    # client lanes None with clients off, concrete with clients on.
+    want_shape = {"committed": (g,), "leaderless": (g,), "elections": (),
+                  "hist": (HIST_SIZE,), "max_latency": (), "safety": (g,),
+                  "client_acked": (g,), "client_retries": (g,),
+                  "client_hist": (HIST_SIZE,), "client_max_lat": ()}
+    for clients in (False, True):
+        m = metrics_init(g, clients=clients)
+        for name in Metrics._fields:
+            leaf = getattr(m, name)
+            if leaf is None:
+                if clients or name not in CLIENT_METRIC_LEAVES:
+                    problems.append(f"Metrics.{name} unexpectedly None "
+                                    f"(clients={clients})")
+                continue
+            if not clients and name in CLIENT_METRIC_LEAVES:
+                problems.append(f"Metrics.{name} present with clients off")
+            if leaf.dtype != jnp.int32:
+                problems.append(f"Metrics.{name} dtype {leaf.dtype} != "
+                                f"int32 (kernel wire lanes are i32)")
+            if leaf.shape != want_shape[name]:
+                problems.append(f"Metrics.{name} shape {leaf.shape} != "
+                                f"{want_shape[name]}")
+    cs = clients_init(cfg_on, g)
+    for name in ClientState._fields:
+        leaf = getattr(cs, name)
+        if leaf.dtype != jnp.int32:
+            problems.append(f"ClientState.{name} dtype {leaf.dtype} != i32")
+        if leaf.shape != (g, cfg_on.client_slots):
+            problems.append(f"ClientState.{name} shape {leaf.shape} != "
+                            f"{(g, cfg_on.client_slots)}")
+    f = flight_init(g)
+    for name in Flight._fields:
+        leaf = getattr(f, name)
+        if leaf.dtype != jnp.int32:
+            problems.append(f"Flight.{name} dtype {leaf.dtype} != int32")
+        if leaf.shape != (RING, g):
+            problems.append(f"Flight.{name} shape {leaf.shape} != "
+                            f"{(RING, g)}")
+    return problems
+
+
+# ----------------------------------------------------- wire registries
+
+
+def wire_registry_problems(pernode_fields: tuple | None = None,
+                           mailbox_fields: tuple | None = None,
+                           client_fields: tuple | None = None) -> list[str]:
+    """The kernel wire registries (`_node_leaves` / `_mb_fields` /
+    `CLIENT_LEAVES` / `_MB_BOOL` / `_n_state_leaves` /
+    `PRESENCE_FIELDS`) against the pytree definitions. Pass a drifted
+    field tuple (e.g. PerNode._fields + ('ghost',)) to prove the
+    auditor names the leaf — the synthetic-drift hook."""
+    import jax
+    import numpy as np
+
+    from raft_tpu import sim
+    from raft_tpu.clients.state import CLIENT_LEAVES, ClientState
+    from raft_tpu.obs.recorder import PRESENCE_FIELDS
+    from raft_tpu.sim import pkernel
+    from raft_tpu.sim.state import Mailbox, PerNode
+
+    pernode_fields = PerNode._fields if pernode_fields is None \
+        else tuple(pernode_fields)
+    mailbox_fields = Mailbox._fields if mailbox_fields is None \
+        else tuple(mailbox_fields)
+    client_fields = ClientState._fields if client_fields is None \
+        else tuple(client_fields)
+
+    problems = []
+    sess_fields = ("session_seq", "snap_session_seq")
+    cfgs = {"clients-off": _base_cfg(), "clients-on": _gate_cfgs()["clients"]}
+    all_on = dataclasses.replace(
+        _gate_cfgs()["clients"], prevote=True, transfer_prob=0.5,
+        read_every=4)
+
+    for label, cfg in cfgs.items():
+        clients = cfg.clients_u32 != 0
+        reg = [f for f, _ in pkernel._node_leaves(cfg)]
+        want = [f for f in pernode_fields
+                if clients or f not in sess_fields]
+        if reg != want:
+            missing = [f for f in want if f not in reg]
+            extra = [f for f in reg if f not in want]
+            problems.append(
+                f"[{label}] pkernel._node_leaves {'misses ' + str(missing) if missing else ''}"
+                f"{' carries stale ' + str(extra) if extra else ''}"
+                f"{' (order drift)' if not missing and not extra else ''} "
+                f"vs PerNode._fields")
+        reg_mb = pkernel._mb_fields(cfg)
+        gated_mb = set()
+        for gate, (mb, _, _) in GATED_LEAVES.items():
+            on = {"prevote": cfg.prevote,
+                  "transfer": cfg.transfer_u32 != 0,
+                  "clients": clients}[gate]
+            if not on:
+                gated_mb.update(mb)
+        want_mb = [f for f in mailbox_fields if f not in gated_mb]
+        if reg_mb != want_mb:
+            missing = [f for f in want_mb if f not in reg_mb]
+            extra = [f for f in reg_mb if f not in want_mb]
+            problems.append(
+                f"[{label}] pkernel._mb_fields misses {missing} / carries "
+                f"stale {extra} vs Mailbox._fields under this cfg")
+        # Leaf count promised to the kernel launch vs the registries.
+        n = (len(reg) + len(reg_mb) + 2
+             + (len(client_fields) if clients else 0))
+        if pkernel._n_state_leaves(cfg) != n:
+            problems.append(
+                f"[{label}] pkernel._n_state_leaves {pkernel._n_state_leaves(cfg)} "
+                f"!= node {len(reg)} + mailbox {len(reg_mb)} + client "
+                f"{len(client_fields) if clients else 0} + alive_prev + "
+                f"group_id = {n}")
+
+        # Kind table vs the real per-leaf shapes (eval_shape).
+        st = jax.eval_shape(lambda c=cfg: sim.init(c, n_groups=2))
+        kind_shape = {"scalar": (cfg.k,), "peer": (cfg.k, cfg.k),
+                      "ring": (cfg.k, cfg.log_cap),
+                      "sess": (cfg.k, cfg.client_slots)}
+        for f, kind in pkernel._node_leaves(cfg):
+            leaf = getattr(st.nodes, f, None)
+            if leaf is None:
+                problems.append(f"[{label}] pkernel._node_leaves lists "
+                                f"{f!r} but PerNode has no such leaf under "
+                                f"this cfg")
+                continue
+            if tuple(leaf.shape[1:]) != kind_shape[kind]:
+                problems.append(
+                    f"[{label}] pkernel._node_leaves files {f!r} as "
+                    f"{kind!r} ({kind_shape[kind]}) but its shape is "
+                    f"{tuple(leaf.shape[1:])}")
+
+    # Bool / u32 casting tables, derived from the all-features-on dtypes.
+    st_on = jax.eval_shape(lambda: sim.init(all_on, n_groups=2))
+    mb_bool = tuple(f for f in mailbox_fields
+                    if getattr(st_on.mailbox, f, None) is not None
+                    and np.dtype(getattr(st_on.mailbox, f).dtype)
+                    == np.bool_)
+    if set(mb_bool) != set(pkernel._MB_BOOL):
+        problems.append(
+            f"pkernel._MB_BOOL {sorted(pkernel._MB_BOOL)} != the bool "
+            f"Mailbox leaves {sorted(mb_bool)} — kfinish would narrow the "
+            f"wrong set")
+    presence = tuple(f for f in mailbox_fields if f.endswith("_present")
+                     or f == "tn_present")
+    if set(presence) != set(PRESENCE_FIELDS):
+        problems.append(
+            f"obs.recorder.PRESENCE_FIELDS {sorted(PRESENCE_FIELDS)} != the "
+            f"mailbox occupancy leaves {sorted(presence)} — the flight "
+            f"recorder's message-volume signal would miss a message type")
+    if client_fields != CLIENT_LEAVES:
+        problems.append(f"CLIENT_LEAVES {CLIENT_LEAVES} != ClientState "
+                        f"fields {client_fields}")
+    return problems
+
+
+# ------------------------------------------------------------ cfg gating
+
+
+def gating_problems() -> list[str]:
+    """Clients-off (and prevote-/transfer-off) must mean THE LEAF IS
+    ABSENT on all three engines: None in the XLA pytree, missing from
+    the kernel wire registries, empty on the CPU oracle — and flipping
+    one gate must change EXACTLY its gated leaves, nothing else."""
+    from raft_tpu.core.cluster import Cluster
+    from raft_tpu.sim import pkernel
+
+    problems = []
+    base = _base_cfg()
+    base_names = _leaf_names(base)
+    for gate, cfg_on in _gate_cfgs().items():
+        mb, nd, st_fields = GATED_LEAVES[gate]
+        expect_new = {f"mailbox.{f}" for f in mb}
+        expect_new |= {f"nodes.{f}" for f in nd}
+        if "clients" in st_fields:
+            from raft_tpu.clients.state import CLIENT_LEAVES
+            expect_new |= {f"clients.{f}" for f in CLIENT_LEAVES}
+        on_names = _leaf_names(cfg_on)
+        got_new = on_names - base_names
+        if got_new != expect_new:
+            problems.append(
+                f"gate {gate!r}: turning it on adds leaves "
+                f"{sorted(got_new)} but the gating table promises "
+                f"{sorted(expect_new)}")
+        if base_names - on_names:
+            problems.append(f"gate {gate!r}: turning it on REMOVES leaves "
+                            f"{sorted(base_names - on_names)}")
+        # Kernel registries mirror the same gate.
+        for f in mb:
+            if f in pkernel._mb_fields(base):
+                problems.append(f"gate {gate!r}: mailbox leaf {f} on the "
+                                f"kernel wire with the gate off")
+            if f not in pkernel._mb_fields(cfg_on):
+                problems.append(f"gate {gate!r}: mailbox leaf {f} missing "
+                                f"from the kernel wire with the gate on")
+        node_off = [f for f, _ in pkernel._node_leaves(base)]
+        node_on = [f for f, _ in pkernel._node_leaves(cfg_on)]
+        for f in nd:
+            if f in node_off:
+                problems.append(f"gate {gate!r}: node leaf {f} on the "
+                                f"kernel wire with the gate off")
+            if f not in node_on:
+                problems.append(f"gate {gate!r}: node leaf {f} missing "
+                                f"from the kernel wire with the gate on")
+    # read_every is deliberately NOT gated (stable trace surface) — a
+    # leaf appearing under it would silently break pre-r05 programs.
+    reads_on = dataclasses.replace(base, read_every=4)
+    if _leaf_names(reads_on) != base_names:
+        problems.append("read_every gates State leaves — the scheduled-"
+                        "read state is contractually always-present")
+    # Metric client lanes follow the clients gate (checked shape-level
+    # by metric_parity_problems; membership here).
+    if set(pkernel._active_metric_leaves(base)) \
+            & set(pkernel.CLIENT_METRIC_LEAVES):
+        problems.append("client metric lanes on the wire with clients off")
+    missing = set(pkernel.CLIENT_METRIC_LEAVES) \
+        - set(pkernel._active_metric_leaves(_gate_cfgs()["clients"]))
+    if missing:
+        problems.append(f"client metric lanes {sorted(missing)} missing "
+                        f"from the wire with clients on")
+    # CPU oracle: the session tables exist (pre-registered) iff the
+    # scheduled-client gate is on.
+    c_off = Cluster(base)
+    c_on = Cluster(_gate_cfgs()["clients"])
+    if c_off.nodes[0].sessions or c_off.nodes[0].snap_sessions:
+        problems.append("oracle Node carries session tables with the "
+                        "clients gate off")
+    s = _gate_cfgs()["clients"].client_slots
+    want_tab = {i: -1 for i in range(s)}
+    if c_on.nodes[0].sessions != want_tab \
+            or c_on.nodes[0].snap_sessions != want_tab:
+        problems.append(
+            f"oracle Node pre-registered tables {c_on.nodes[0].sessions} != "
+            f"the batched init's slots 0..{s - 1} at -1")
+    return problems
+
+
+# ------------------------------------------------------------ shard rule
+
+
+def shard_rule_problems() -> list[str]:
+    """parallel.kmesh.kleaf_spec must place EVERY wire leaf: each leaf
+    of the real kinit output (eval_shape) must carry the folded
+    [..., GS, LANE] layout, and the spec must shard exactly dim -2 on
+    the group axis."""
+    import jax
+
+    from raft_tpu import sim
+    from raft_tpu.obs.recorder import flight_init
+    from raft_tpu.parallel.kmesh import kleaf_spec
+    from raft_tpu.parallel.mesh import AXIS
+    from raft_tpu.sim import pkernel
+
+    problems = []
+    for label, cfg in (("clients-off", _base_cfg()),
+                       ("clients-on", _gate_cfgs()["clients"])):
+        st = jax.eval_shape(lambda c=cfg: sim.init(c, n_groups=2))
+        fl = jax.eval_shape(lambda: flight_init(2))
+        leaves = jax.eval_shape(
+            lambda s, f, c=cfg: pkernel.kinit(c, s, None, f)[0], st, fl)
+        for i, leaf in enumerate(leaves):
+            shape = tuple(leaf.shape)
+            if len(shape) < 2 or shape[-1] != pkernel.LANE \
+                    or shape[-2] % pkernel.SUB:
+                problems.append(
+                    f"[{label}] wire leaf #{i}: shape {shape} is not the "
+                    f"folded [..., GS, {pkernel.LANE}] layout kleaf_spec "
+                    f"shards")
+                continue
+            spec = tuple(kleaf_spec(leaf))
+            want = tuple([None] * (len(shape) - 2) + [AXIS, None])
+            if spec != want:
+                problems.append(
+                    f"[{label}] wire leaf #{i}: kleaf_spec {spec} does not "
+                    f"place the folded GS axis (want {want})")
+    return problems
+
+
+# ------------------------------------------------------------ checkpoint
+
+
+def checkpoint_problems(ckpt_mod=None,
+                        include_behavioral: bool = True) -> list[str]:
+    """checkpoint.save/load coverage: the optional-field sets must be
+    exactly the statically-gated leaves; behaviorally (tiny G, host
+    npz in memory), a round trip must be exact, pre-r07/r09 files must
+    backfill (safety -> ones, client lanes -> zeros, missing cfg knobs
+    -> defaults), and a missing REQUIRED leaf must raise naming the
+    field. Pass `ckpt_mod` (a save/load namespace) to audit a drifted
+    implementation — the synthetic-drift hook."""
+    import numpy as np
+
+    from raft_tpu.clients.state import ClientState
+    from raft_tpu.sim import checkpoint as real_ckpt
+    from raft_tpu.sim.state import Mailbox, PerNode
+
+    ckpt = real_ckpt if ckpt_mod is None else ckpt_mod
+    problems = []
+
+    # Static: optional == statically-gated, per class.
+    gated_mb, gated_nd = set(), set()
+    for mb, nd, _ in GATED_LEAVES.values():
+        gated_mb.update(mb)
+        gated_nd.update(nd)
+    if real_ckpt._optional_fields(Mailbox) != frozenset(gated_mb):
+        problems.append(
+            f"checkpoint._optional_fields(Mailbox) "
+            f"{sorted(real_ckpt._optional_fields(Mailbox))} != the "
+            f"statically-gated mailbox leaves {sorted(gated_mb)}")
+    if real_ckpt._optional_fields(PerNode) != frozenset(gated_nd):
+        problems.append(
+            f"checkpoint._optional_fields(PerNode) "
+            f"{sorted(real_ckpt._optional_fields(PerNode))} != the "
+            f"statically-gated node leaves {sorted(gated_nd)}")
+    if real_ckpt._optional_fields(ClientState):
+        problems.append(
+            "ClientState declares optional leaves — the clients subtree is "
+            "all-or-nothing; an optional leaf would load as None and crash "
+            "the workload transition")
+    if not include_behavioral:
+        return problems
+
+    from raft_tpu import sim
+    from raft_tpu.analysis.bytemodel import iter_named_leaves
+    from raft_tpu.sim.run import metrics_init
+
+    def roundtrip(cfg, strip=(), patch_cfg=None, expect_raise=None,
+                  load_cfg="same"):
+        """save -> optionally strip npz keys -> load. Returns
+        (state, tick, metrics) or the raised exception."""
+        st = sim.init(cfg, n_groups=2)
+        met = metrics_init(2, clients=cfg.clients_u32 != 0)
+        buf = io.BytesIO()
+        ckpt.save(buf, st, 7, metrics=met, cfg=cfg)
+        buf.seek(0)
+        if strip or patch_cfg:
+            with np.load(buf) as z:
+                ghost = [k for k in strip if k not in z.files]
+                if ghost:
+                    # A rename in checkpoint._flatten's key scheme would
+                    # otherwise turn the backfill checks vacuous: the
+                    # strip removes nothing, load sees a complete file,
+                    # and the pass reports clean without exercising the
+                    # backfill at all.
+                    problems.append(
+                        f"backfill check could not strip {ghost} — the "
+                        f"checkpoint key naming moved and the auditor's "
+                        f"strip targets went stale")
+                data = {k: z[k] for k in z.files if k not in strip}
+            if patch_cfg:
+                saved = json.loads(bytes(data["__cfg__"]).decode())
+                for k in patch_cfg:
+                    saved.pop(k, None)
+                data["__cfg__"] = np.bytes_(json.dumps(saved,
+                                                       sort_keys=True))
+            buf = io.BytesIO()
+            np.savez(buf, **data)
+            buf.seek(0)
+        try:
+            out = ckpt.load(buf, cfg=cfg if load_cfg == "same" else load_cfg)
+        except Exception as e:  # noqa: BLE001 — audited, not handled
+            if expect_raise and isinstance(e, expect_raise):
+                return e
+            problems.append(f"checkpoint load raised {type(e).__name__}: "
+                            f"{e} (cfg={cfg_label}, strip={sorted(strip)})")
+            return None
+        if expect_raise:
+            problems.append(
+                f"checkpoint load SUCCEEDED where it must refuse "
+                f"(cfg={cfg_label}, strip={sorted(strip)}) — a corrupt or "
+                f"mismatched file would resume silently")
+        return (st, met, out)
+
+    all_on = dataclasses.replace(
+        _gate_cfgs()["clients"], prevote=True, transfer_prob=0.5,
+        read_every=4)
+    for cfg_label, cfg in (("base", _base_cfg()), ("all-on", all_on)):
+        r = roundtrip(cfg)
+        if r is None:
+            continue
+        st, met, (st2, t2, met2) = r
+        if t2 != 7:
+            problems.append(f"[{cfg_label}] round-trip lost the tick "
+                            f"counter ({t2} != 7)")
+        for (name, a), (_, b) in zip(iter_named_leaves(st),
+                                     iter_named_leaves(st2)):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                problems.append(f"[{cfg_label}] round-trip changed state "
+                                f"leaf {name}")
+        for (name, a), (_, b) in zip(iter_named_leaves(met),
+                                     iter_named_leaves(met2)):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                problems.append(f"[{cfg_label}] round-trip changed metric "
+                                f"leaf {name}")
+
+    # Pre-r07 backfill: a file without metrics.safety resumes with a
+    # clean (all-ones) safety fold.
+    cfg_label = "base"
+    r = roundtrip(_base_cfg(), strip=("metrics.safety",))
+    ok = False
+    if r is not None and not isinstance(r, Exception):
+        _, _, (_, _, met2) = r
+        ok = met2 is not None and np.all(np.asarray(met2.safety) == 1)
+    if not ok:
+        problems.append("pre-r07 backfill drift: loading a checkpoint "
+                        "without metrics.safety must fill ones "
+                        "(registry: checkpoint.load safety backfill)")
+
+    # Pre-r09 backfill: a client universe whose file predates the SLO
+    # lanes resumes with zeroed lanes.
+    cfg_label = "all-on"
+    client_lanes = ("metrics.client_acked", "metrics.client_retries",
+                    "metrics.client_hist", "metrics.client_max_lat")
+    r = roundtrip(all_on, strip=client_lanes)
+    ok = False
+    if r is not None and not isinstance(r, Exception):
+        _, _, (_, _, met2) = r
+        ok = (met2 is not None
+              and met2.client_acked is not None
+              and np.all(np.asarray(met2.client_acked) == 0)
+              and met2.client_hist is not None
+              and np.all(np.asarray(met2.client_hist) == 0))
+    if not ok:
+        problems.append("pre-r09 backfill drift: loading a client "
+                        "checkpoint without the client metric lanes "
+                        "must fill zeros (registry: checkpoint.load "
+                        "client-lane backfill)")
+
+    # Pre-r09 cfg backfill: a saved cfg dict missing a later-added knob
+    # loads against that knob's default.
+    cfg_label = "base"
+    r = roundtrip(_base_cfg(), patch_cfg=("client_rate", "client_slots"))
+    if r is None or isinstance(r, Exception):
+        problems.append("cfg-default backfill drift: a checkpoint whose "
+                        "embedded cfg predates a knob must load against "
+                        "the knob's default (registry: checkpoint.load "
+                        "cfg setdefault)")
+
+    # Strictness: a missing REQUIRED leaf must raise, naming the field.
+    r = roundtrip(_base_cfg(), strip=("state.nodes.term",),
+                  expect_raise=(KeyError,))
+    if isinstance(r, Exception) and "state.nodes.term" not in str(r):
+        problems.append(f"missing-leaf error does not name the field: {r}")
+    # A mismatched semantic cfg must refuse to resume.
+    roundtrip(_base_cfg(), load_cfg=dataclasses.replace(_base_cfg(),
+                                                        seed=99),
+              expect_raise=(ValueError,))
+    return problems
+
+
+# ------------------------------------------------------------- rng parity
+
+
+def rng_parity_problems() -> list[str]:
+    """utils.rng (host ints) and utils.jrng (u32 lanes) must export the
+    same schedule surface — a draw added to one side only is exactly
+    the untagged-randomness drift the linter hunts dynamically."""
+    import inspect
+
+    from raft_tpu.utils import jrng, rng
+
+    def public_fns(mod):
+        return {n for n, v in vars(mod).items()
+                if callable(v) and not n.startswith("_")
+                and getattr(v, "__module__", None) == mod.__name__}
+
+    problems = []
+    only_rng = public_fns(rng) - public_fns(jrng)
+    only_jrng = public_fns(jrng) - public_fns(rng)
+    if only_rng:
+        problems.append(f"rng functions missing a jrng twin: "
+                        f"{sorted(only_rng)}")
+    if only_jrng:
+        problems.append(f"jrng functions missing an rng twin: "
+                        f"{sorted(only_jrng)}")
+    # Same coordinate signature, so call sites cannot transpose args.
+    for n in public_fns(rng) & public_fns(jrng):
+        a = list(inspect.signature(getattr(rng, n)).parameters)
+        b = list(inspect.signature(getattr(jrng, n)).parameters)
+        if a != b:
+            problems.append(f"rng.{n}{a} and jrng.{n}{b} disagree on "
+                            f"parameter names/order")
+    return problems
+
+
+def contract_problems(include_behavioral: bool = True) -> list[str]:
+    """All contract passes, concatenated."""
+    out = []
+    out += metric_parity_problems()
+    out += wire_registry_problems()
+    out += gating_problems()
+    out += shard_rule_problems()
+    out += checkpoint_problems(include_behavioral=include_behavioral)
+    out += rng_parity_problems()
+    return out

@@ -1,0 +1,397 @@
+"""AST purity/determinism linter over the tick implementations
+(DESIGN.md §11).
+
+The whole repo's bit-identity story rests on three source-level
+properties of `sim/step.py`, `sim/pkernel.py`, and
+`clients/workload.py`:
+
+1. **Tagged randomness only** — every stochastic draw routes through
+   the counter-based `utils.rng`/`utils.jrng` TAG_* hashes. A stray
+   `jax.random` / `random` / `np.random` / `secrets` / `uuid` call is
+   hidden state: it breaks oracle/XLA/kernel tri-identity and makes
+   checkpoints non-resumable.
+2. **No Python-level branching on traced values** — an `if`/`while`
+   whose test depends on a traced array either crashes under jit
+   (ConcretizationTypeError) or, worse, silently bakes one branch into
+   the compiled program. Static branching on `cfg` knobs is the
+   codebase's whole gating idiom and stays legal.
+3. **The client workload transition is purely elementwise** — ONE jnp
+   implementation serves [G, S] XLA leaves and [S, 8, 128] kernel
+   tiles ONLY because `client_update`/`submit_payloads` never use an
+   op that couples lanes (reductions, reshapes, gathers).
+
+This is a lint, not a proof: traced-ness is propagated by a small
+forward dataflow (annotation-seeded + jnp/jrng-call-seeded + a short
+conventional-name list), which can miss a branch on an unannotated
+parameter — but every rule is tuned to be zero-noise on the real
+modules (enforced in tier-1), so a finding is always worth reading.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable
+
+# Default lint surface: the three modules whose purity the engines'
+# bit-identity contract depends on.
+DEFAULT_TARGETS = ("sim/step.py", "sim/pkernel.py", "clients/workload.py")
+
+# Pytree / array annotations that seed traced-ness for parameters.
+ARRAY_TYPES = {"PerNode", "Mailbox", "State", "ClientState", "Metrics",
+               "KMetrics", "Flight", "ndarray", "Array"}
+
+# Conventional traced-value parameter names in the tick modules —
+# belt-and-braces seeding for unannotated handler signatures.
+TRACED_PARAM_NAMES = {"ns", "st", "nodes", "mailbox", "inbox", "outbox",
+                      "ib", "out", "cl", "cs", "met", "fl", "m", "state",
+                      "clients", "alive_prev", "alive_now", "carry"}
+
+# Attribute reads that are static at trace time even on traced values.
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "_fields"}
+
+# Call roots whose results are traced arrays.
+_TRACED_CALL_ROOTS = ("jnp", "jrng")
+_TRACED_CALL_PREFIXES = (("jax", "lax"), ("jax", "numpy"), ("jax", "nn"),
+                         ("jax", "vmap"), ("jax", "tree"), ("jax", "jit"))
+
+# Modules whose mere use is nondeterminism in the tick surface.
+FORBIDDEN_MODULES = {"random", "secrets", "uuid"}
+FORBIDDEN_ATTR_CHAINS = (("jax", "random"), ("np", "random"),
+                         ("numpy", "random"), ("os", "urandom"))
+
+# jnp ops that are elementwise (lane-local) — the ONLY jnp calls the
+# client workload transition may make. Reducers/reshapers couple lanes
+# and break the one-implementation-two-layouts contract.
+ELEMENTWISE_JNP = {
+    "where", "minimum", "maximum", "abs", "clip", "sign", "mod",
+    "equal", "not_equal", "greater", "less", "greater_equal",
+    "less_equal", "logical_and", "logical_or", "logical_not",
+    "logical_xor", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "invert", "left_shift", "right_shift", "add", "subtract",
+    "multiply", "floor_divide", "remainder", "negative",
+    "zeros_like", "ones_like", "full_like", "asarray",
+    "int32", "uint32", "bool_", "float32",
+}
+ELEMENTWISE_METHODS = {"astype"}
+WORKLOAD_FNS = ("client_update", "submit_payloads")
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _attr_chain(node) -> tuple:
+    """('jax', 'random', 'split') for jax.random.split; () if the
+    expression is not a plain dotted name."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def _is_traced_call(node: ast.Call) -> bool:
+    chain = _attr_chain(node.func)
+    if not chain:
+        return False
+    if chain[0] in _TRACED_CALL_ROOTS:
+        return True
+    return any(chain[:len(p)] == p for p in _TRACED_CALL_PREFIXES)
+
+
+class _TracedScope:
+    """Forward dataflow of traced-ness through one function body."""
+
+    def __init__(self, fn: ast.FunctionDef, inherited: set):
+        self.traced = set(inherited)
+        for arg in list(fn.args.args) + list(fn.args.kwonlyargs):
+            ann = arg.annotation
+            names = set()
+            if isinstance(ann, (ast.Name, ast.Attribute)):
+                chain = _attr_chain(ann)
+                if chain:
+                    names.add(chain[-1])
+            elif isinstance(ann, ast.Constant) and isinstance(ann.value,
+                                                              str):
+                names.update(ann.value.replace("|", " ").split())
+            if names & ARRAY_TYPES or arg.arg in TRACED_PARAM_NAMES:
+                self.traced.add(arg.arg)
+
+    def expr_is_traced(self, node) -> bool:
+        """Does `node` (an expression) depend on a traced value, after
+        the static exemptions (`.shape`/`.dtype`/..., `is` compares,
+        len/isinstance calls, constants)?"""
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr_is_traced(node.value)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return (self.expr_is_traced(node.left)
+                    or any(self.expr_is_traced(c) for c in node.comparators))
+        if isinstance(node, ast.Call):
+            if _is_traced_call(node):
+                return True
+            # A call yields a traced value iff its CALLEE is traced (a
+            # method on a traced array: ns._replace, arr.at[i].set) or
+            # rooted at jnp/jrng/jax (above). Argument traced-ness does
+            # NOT propagate through unknown callees — host helpers
+            # routinely take pytrees and return host ints/np arrays,
+            # and flagging those drowns the signal (the cost: a branch
+            # on a local helper's traced result is missed — a lint,
+            # not a proof).
+            return self.expr_is_traced(node.func)
+        if isinstance(node, ast.Subscript):
+            return (self.expr_is_traced(node.value)
+                    or self.expr_is_traced(node.slice))
+        if isinstance(node, (ast.BoolOp,)):
+            return any(self.expr_is_traced(v) for v in node.values)
+        if isinstance(node, ast.BinOp):
+            return (self.expr_is_traced(node.left)
+                    or self.expr_is_traced(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.expr_is_traced(node.operand)
+        if isinstance(node, ast.IfExp):
+            # Only the TEST branches at Python level; the arms are data.
+            return (self.expr_is_traced(node.test)
+                    or self.expr_is_traced(node.body)
+                    or self.expr_is_traced(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr_is_traced(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.expr_is_traced(v) for v in node.values
+                       if v is not None)
+        if isinstance(node, ast.Starred):
+            return self.expr_is_traced(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            # Comprehensions over traced iterables yield traced elements.
+            return any(self.expr_is_traced(g.iter)
+                       for g in node.generators)
+        return False
+
+    def _mark_targets(self, target):
+        if isinstance(target, ast.Name):
+            self.traced.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._mark_targets(e)
+        elif isinstance(target, ast.Starred):
+            self._mark_targets(target.value)
+
+    def propagate(self, body: Iterable[ast.stmt]):
+        """Two passes so a use-before-later-assign inside a loop body
+        still converges for this flat propagation."""
+        for _ in range(2):
+            for stmt in ast.walk(ast.Module(body=list(body),
+                                            type_ignores=[])):
+                if isinstance(stmt, ast.Assign):
+                    if self.expr_is_traced(stmt.value):
+                        for t in stmt.targets:
+                            self._mark_targets(t)
+                elif isinstance(stmt, ast.AugAssign):
+                    if (self.expr_is_traced(stmt.value)
+                            or self.expr_is_traced(stmt.target)):
+                        self._mark_targets(stmt.target)
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                    if self.expr_is_traced(stmt.value):
+                        self._mark_targets(stmt.target)
+                elif isinstance(stmt, ast.For):
+                    if self.expr_is_traced(stmt.iter):
+                        self._mark_targets(stmt.target)
+
+
+def _lint_randomness(tree: ast.AST, path: str) -> list[Finding]:
+    out = []
+    seen = set()   # (lineno, chain) — jax.random.X also matches at its
+    # nested jax.random node; report each draw once
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = alias.name.split(".")[0]
+                if root in FORBIDDEN_MODULES:
+                    out.append(Finding(path, node.lineno,
+                                       "untagged-randomness",
+                                       f"import of {alias.name!r} — all "
+                                       f"draws must route through the "
+                                       f"utils.rng/jrng TAG_* hashes"))
+                if alias.name in ("jax.random", "numpy.random"):
+                    out.append(Finding(path, node.lineno,
+                                       "untagged-randomness",
+                                       f"import of {alias.name} — "
+                                       f"stateful/seeded PRNGs break "
+                                       f"tri-engine bit-identity"))
+        elif isinstance(node, ast.ImportFrom):
+            mod = (node.module or "").split(".")[0]
+            if mod in FORBIDDEN_MODULES:
+                out.append(Finding(path, node.lineno, "untagged-randomness",
+                                   f"import from {node.module!r}"))
+            if node.module in ("jax", "numpy") and any(
+                    a.name == "random" for a in node.names):
+                out.append(Finding(path, node.lineno, "untagged-randomness",
+                                   f"from {node.module} import random"))
+            if node.module in ("jax.random", "numpy.random"):
+                out.append(Finding(path, node.lineno, "untagged-randomness",
+                                   f"import from {node.module}"))
+        elif isinstance(node, ast.Attribute):
+            chain = _attr_chain(node)
+            for bad in FORBIDDEN_ATTR_CHAINS:
+                if chain[:len(bad)] == bad:
+                    if (node.lineno, bad) not in seen:
+                        seen.add((node.lineno, bad))
+                        out.append(Finding(
+                            path, node.lineno, "untagged-randomness",
+                            f"use of {'.'.join(chain)} — every draw must "
+                            f"be a pure (seed, TAG_*, coords) hash via "
+                            f"utils.rng/jrng"))
+                    break
+    return out
+
+
+def _lint_traced_branches(tree: ast.AST, path: str) -> list[Finding]:
+    out = []
+
+    def visit_fn(fn: ast.FunctionDef, inherited: set):
+        scope = _TracedScope(fn, inherited)
+        scope.propagate(fn.body)
+
+        # Walk fn's OWN statements only — nested function bodies are
+        # visited once, below, with this scope inherited (walking them
+        # here too would double-report their findings under the wrong
+        # scope).
+        own, nested, stack = [], [], list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.append(node)
+                continue
+            own.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+
+        for node in own:
+            test = None
+            kind = None
+            if isinstance(node, (ast.If, ast.While)):
+                test, kind = node.test, type(node).__name__.lower()
+            elif isinstance(node, ast.IfExp):
+                test, kind = node.test, "conditional expression"
+            elif isinstance(node, ast.Assert):
+                test, kind = node.test, "assert"
+            if test is not None and scope.expr_is_traced(test):
+                names = sorted({n.id for n in ast.walk(test)
+                                if isinstance(n, ast.Name)
+                                and n.id in scope.traced})
+                out.append(Finding(
+                    path, node.lineno, "traced-branch",
+                    f"Python-level {kind} on traced value(s) "
+                    f"{names or '<expr>'} in {fn.name}() — branch with "
+                    f"jnp.where / static cfg gates instead"))
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if chain and chain[-1] in ("bool", "int", "float") \
+                        and len(chain) == 1 \
+                        and any(scope.expr_is_traced(a)
+                                for a in node.args):
+                    out.append(Finding(
+                        path, node.lineno, "traced-branch",
+                        f"host {chain[-1]}() coercion of a traced value "
+                        f"in {fn.name}() — forces a device sync / "
+                        f"concretization"))
+
+        for sub in nested:
+            visit_fn(sub, scope.traced)
+
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.FunctionDef):
+            visit_fn(node, set())
+        elif isinstance(node, ast.ClassDef):
+            # Host-side classes (HostClients, the oracle mirror) are
+            # exempt from the traced-branch rule: they ARE the python
+            # reference. Randomness rules still apply (walked above).
+            continue
+    return out
+
+
+def _lint_workload_elementwise(tree: ast.AST, path: str,
+                               fns: tuple = WORKLOAD_FNS) -> list[Finding]:
+    out = []
+    for node in (tree.body if isinstance(tree, ast.Module) else []):
+        if not (isinstance(node, ast.FunctionDef) and node.name in fns):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            chain = _attr_chain(sub.func)
+            if not chain:
+                continue
+            bad = None
+            if chain[0] == "jnp" and len(chain) == 2 \
+                    and chain[1] not in ELEMENTWISE_JNP:
+                bad = (f"jnp.{chain[1]} is not in the elementwise "
+                       f"allowlist")
+            elif chain[0] == "jax":
+                bad = f"{'.'.join(chain)} call"
+            elif len(chain) >= 2 and chain[-1] not in ELEMENTWISE_METHODS \
+                    and chain[-1] in ("sum", "max", "min", "mean", "prod",
+                                      "reshape", "transpose", "ravel",
+                                      "flatten", "dot", "sort", "argsort",
+                                      "argmax", "argmin", "cumsum", "take"):
+                bad = f"method .{chain[-1]}() couples lanes"
+            if bad is None and any(k.arg == "axis" for k in sub.keywords):
+                bad = f"{'.'.join(chain)} with an axis= argument reduces " \
+                      f"over an axis"
+            if bad:
+                out.append(Finding(
+                    path, sub.lineno, "non-elementwise-workload",
+                    f"{bad} inside {node.name}() — the client transition "
+                    f"must stay purely elementwise so one implementation "
+                    f"serves the [G, S] and [S, 8, 128] layouts"))
+    return out
+
+
+def lint_file(path: str, *, workload_rules: bool | None = None
+              ) -> list[Finding]:
+    """All rules over one file. `workload_rules` defaults to "is this
+    clients/workload.py" and forces the elementwise pass on fixture
+    files when True."""
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    if workload_rules is None:
+        workload_rules = os.path.basename(path) == "workload.py"
+    out = _lint_randomness(tree, path)
+    out += _lint_traced_branches(tree, path)
+    if workload_rules:
+        out += _lint_workload_elementwise(tree, path)
+    return out
+
+
+def lint_default() -> list[Finding]:
+    """Lint the contract surface: sim/step.py, sim/pkernel.py,
+    clients/workload.py (resolved relative to the installed package)."""
+    import raft_tpu
+    root = os.path.dirname(os.path.abspath(raft_tpu.__file__))
+    out = []
+    for rel in DEFAULT_TARGETS:
+        out += lint_file(os.path.join(root, rel))
+    return out

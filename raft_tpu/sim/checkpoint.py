@@ -54,14 +54,24 @@ def _shard_metrics(metrics: Metrics, sharding) -> Metrics:
         for f in Metrics._fields})
 
 
-def _flatten(prefix: str, obj, out: dict):
-    if hasattr(obj, "_fields"):   # NamedTuple node
-        for f in obj._fields:
-            _flatten(f"{prefix}{f}.", getattr(obj, f), out)
-    elif obj is None:
-        pass   # empty pytree slot (e.g. Mailbox pv_* with prevote off)
+def iter_named_leaves(tree, prefix: str = ""):
+    """(dot-path, leaf) over a NamedTuple pytree, skipping None
+    subtrees (empty pytree slots, e.g. Mailbox pv_* with prevote off).
+    THE naming rule for checkpoint keys — the engine-contract auditor
+    (raft_tpu/analysis) walks with this same function so its leaf
+    names can never drift from the npz keys `save` writes."""
+    if tree is None:
+        return
+    if hasattr(tree, "_fields"):   # NamedTuple node
+        for f in tree._fields:
+            yield from iter_named_leaves(getattr(tree, f), f"{prefix}{f}.")
     else:
-        out[prefix[:-1]] = np.asarray(obj)
+        yield prefix[:-1], tree
+
+
+def _flatten(prefix: str, obj, out: dict):
+    for name, leaf in iter_named_leaves(obj, prefix):
+        out[name] = np.asarray(leaf)
 
 
 def save(path, st: State, t: int, metrics: Optional[Metrics] = None,

@@ -5,6 +5,13 @@ and shape-aligned — the bench promotion gates and kfinish's name-based
 wire indexing all assume it. Exits nonzero on any drift; runs in tier-1
 via tests/test_obs.py (fast: builds two host-side pytrees, no jit).
 
+Since r10 this is a thin wrapper over ONE source of truth: the
+engine-contract auditor's metric-parity pass
+(`raft_tpu.analysis.contracts.metric_parity_problems` — DESIGN.md
+§11). `scripts/static_audit.py` / `raft-tpu-audit` run this pass plus
+the full contract surface (wire registries, shard rule, checkpoint
+coverage, derived byte model, purity lint).
+
     python scripts/check_metric_parity.py
 """
 
@@ -24,88 +31,9 @@ def check() -> list[str]:
     """Returns the list of parity problems (empty = aligned)."""
     import jax
     jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
 
-    from raft_tpu.clients.state import CLIENT_LEAVES, ClientState, \
-        clients_init
-    from raft_tpu.config import RaftConfig
-    from raft_tpu.obs.recorder import FLIGHT_LEAVES, RING, Flight, flight_init
-    from raft_tpu.sim.pkernel import (CLIENT_METRIC_LEAVES, KMetrics,
-                                      METRIC_LEAVES, N_METRIC_LEAVES,
-                                      _active_metric_leaves)
-    from raft_tpu.sim.run import HIST_SIZE, Metrics, metrics_init
-
-    problems = []
-    if KMetrics._fields != METRIC_LEAVES:
-        problems.append(f"KMetrics fields {KMetrics._fields} != wire order "
-                        f"METRIC_LEAVES {METRIC_LEAVES}")
-    if set(Metrics._fields) != set(METRIC_LEAVES):
-        problems.append(f"Metrics fields {sorted(Metrics._fields)} != "
-                        f"METRIC_LEAVES names {sorted(METRIC_LEAVES)}")
-    if N_METRIC_LEAVES != len(METRIC_LEAVES):
-        problems.append("N_METRIC_LEAVES out of sync with METRIC_LEAVES")
-    if Flight._fields != FLIGHT_LEAVES:
-        problems.append(f"Flight fields {Flight._fields} != wire order "
-                        f"FLIGHT_LEAVES {FLIGHT_LEAVES}")
-    if ClientState._fields != CLIENT_LEAVES:
-        problems.append(f"ClientState fields {ClientState._fields} != wire "
-                        f"order CLIENT_LEAVES {CLIENT_LEAVES}")
-
-    # The active wire subset must drop EXACTLY the client lanes when
-    # clients are off, and be the full tuple when on.
-    cfg_off = RaftConfig(seed=1)
-    cfg_on = RaftConfig(seed=1, sessions=True, cmds_per_tick=0,
-                        client_rate=0.2, client_slots=3)
-    if _active_metric_leaves(cfg_on) != METRIC_LEAVES:
-        problems.append("clients-on active metric leaves != METRIC_LEAVES")
-    want_off = tuple(n for n in METRIC_LEAVES
-                     if n not in CLIENT_METRIC_LEAVES)
-    if _active_metric_leaves(cfg_off) != want_off:
-        problems.append(f"clients-off active metric leaves "
-                        f"{_active_metric_leaves(cfg_off)} != {want_off}")
-
-    g = 4
-    # The kernel wire is i32 lanes: every metric leaf must be i32, with
-    # the shapes kinit folds ([G] per-group, scalar, or [H] histogram);
-    # client lanes None with clients off, concrete with clients on.
-    want_shape = {"committed": (g,), "leaderless": (g,), "elections": (),
-                  "hist": (HIST_SIZE,), "max_latency": (), "safety": (g,),
-                  "client_acked": (g,), "client_retries": (g,),
-                  "client_hist": (HIST_SIZE,), "client_max_lat": ()}
-    for clients in (False, True):
-        m = metrics_init(g, clients=clients)
-        for name in Metrics._fields:
-            leaf = getattr(m, name)
-            if leaf is None:
-                if clients or name not in CLIENT_METRIC_LEAVES:
-                    problems.append(f"Metrics.{name} unexpectedly None "
-                                    f"(clients={clients})")
-                continue
-            if not clients and name in CLIENT_METRIC_LEAVES:
-                problems.append(f"Metrics.{name} present with clients off")
-            if leaf.dtype != jnp.int32:
-                problems.append(f"Metrics.{name} dtype {leaf.dtype} != "
-                                f"int32 (kernel wire lanes are i32)")
-            if leaf.shape != want_shape[name]:
-                problems.append(f"Metrics.{name} shape {leaf.shape} != "
-                                f"{want_shape[name]}")
-    cs = clients_init(cfg_on, g)
-    for name in ClientState._fields:
-        leaf = getattr(cs, name)
-        if leaf.dtype != jnp.int32:
-            problems.append(f"ClientState.{name} dtype {leaf.dtype} != i32")
-        if leaf.shape != (g, cfg_on.client_slots):
-            problems.append(f"ClientState.{name} shape {leaf.shape} != "
-                            f"{(g, cfg_on.client_slots)}")
-    f = flight_init(g)
-    for name in Flight._fields:
-        leaf = getattr(f, name)
-        if leaf.dtype != jnp.int32:
-            problems.append(f"Flight.{name} dtype {leaf.dtype} != int32")
-        if leaf.shape != (RING, g):
-            problems.append(f"Flight.{name} shape {leaf.shape} != "
-                            f"{(RING, g)}")
-    return problems
+    from raft_tpu.analysis.contracts import metric_parity_problems
+    return metric_parity_problems()
 
 
 def main() -> int:

@@ -200,6 +200,14 @@ def main():
             return 2
         return _reexec_with_host_devices(args.devices)
 
+    # Pre-flight engine-contract audit (DESIGN.md §11; cheap —
+    # eval_shape + AST only): a sweep verdict over a drifted wire
+    # layout would be evidence about the wrong program. AFTER the
+    # re-exec branch, so the virtual-device path pays it exactly once.
+    from raft_tpu import analysis
+    analysis.startup_audit(level="static",
+                           log=lambda s: print(s, file=sys.stderr))
+
     dev = jax.devices()[0]
     print(f"platform: {dev.platform} ({dev.device_kind}); "
           f"{args.groups} groups x {args.ticks} ticks per universe"

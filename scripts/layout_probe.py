@@ -151,6 +151,27 @@ def bytes_per_group_report(cfg=None):
           f"{pkernel.hbm_ceiling_groups(ccfg):>9,d} groups "
           f"(vs {pkernel.hbm_ceiling_groups(cfg):,d} without clients)")
 
+    # Derived-model reconciliation + widening-waste block (DESIGN.md
+    # §11): the engine-contract auditor recomputes every number above
+    # from dtype x shape and names the i32-widened bool leaves — the
+    # measured starting point for the packed-layout work (ROADMAP
+    # item 2). Any derived-vs-pinned disagreement prints here AND
+    # fails `scripts/static_audit.py`.
+    from raft_tpu.analysis import bytemodel
+    for label, c in (("clients-off", cfg), ("clients-on", ccfg)):
+        model = bytemodel.derived_wire_model(c)
+        verdict = "derived == pinned" if not model["problems"] else \
+            "; ".join(model["problems"])
+        print(f"derived wire model [{label}]: "
+              f"{model['wire_bytes_derived']} B/group ({verdict})")
+    w = bytemodel.derived_wire_model(cfg)["widening"]
+    print(f"i32-widened bool leaves ({len(w['leaves'])}, structural — "
+          f"Mosaic transports no i1 vectors): "
+          f"{w['waste_bytes_per_group']} B/group of widening waste "
+          f"(wire {w['wire_bytes']} B vs {w['native_bytes']} B if i8):")
+    for name in w["leaves"]:
+        print(f"    {name}")
+
 
 def main():
     ap = argparse.ArgumentParser()

@@ -1,0 +1,22 @@
+"""Static engine-contract audit: prove the three engines, the kernel
+wire model, and the checkpoint format agree — before anything runs
+(DESIGN.md §11). Thin wrapper over `raft_tpu.analysis.cli` (also
+installed as the `raft-tpu-audit` console script).
+
+    python scripts/static_audit.py            # rc != 0 on any drift
+    python scripts/static_audit.py --json     # machine-readable report
+    python scripts/static_audit.py --bytes    # per-leaf derived bytes
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # runnable as `python scripts/...`
+
+from raft_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,261 @@
+"""Tier-1 coverage for the static engine-contract auditor
+(raft_tpu/analysis/ — DESIGN.md §11).
+
+Two halves:
+
+- the auditor runs CLEAN on the current tree, and its derived byte
+  model reproduces the pinned wire numbers (8,308 B/group clients-off,
+  11,056 B/group clients-on) EXACTLY — the acceptance gate that makes
+  the hand model derived-not-pinned;
+- synthetic drift is NAMED: a fake State leaf, a dropped checkpoint
+  backfill, an untagged jax.random draw, a Python branch on a traced
+  value, a lane-coupling op in the workload transition — each must
+  surface as a problem string carrying the leaf/file:line and the
+  registry that drifted, and the script entry must exit nonzero.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+from raft_tpu import analysis
+from raft_tpu.analysis import bytemodel, contracts, lint
+from raft_tpu.sim import checkpoint
+from raft_tpu.sim.state import Mailbox, PerNode
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- clean tree
+
+
+def test_full_audit_clean():
+    """Every pass — contracts, gating, shard rule, checkpoint coverage
+    + backfills, byte model, purity lint — holds on the current tree."""
+    report = analysis.audit_report(level="full")
+    assert report["problems"] == []
+    assert report["lint"] == []
+    assert report["ok"]
+
+
+def test_derived_bytes_reproduce_pinned_wire_models():
+    """The acceptance pin: bytes/group DERIVED from dtype x shape must
+    equal the hand-pinned wire model exactly — 8,308 B (clients off)
+    and 11,056 B (clients on), the DESIGN.md §9/§10 headline numbers."""
+    m_off = bytemodel.derived_wire_model(bytemodel.headline_cfg())
+    assert m_off["problems"] == []
+    assert m_off["wire_bytes_derived"] == 8308
+    assert m_off["wire_bytes_pinned"] == 8308
+    assert m_off["kinit_words_per_group"] * 4 == 8308
+
+    m_on = bytemodel.derived_wire_model(bytemodel.clients_cfg())
+    assert m_on["problems"] == []
+    assert m_on["wire_bytes_derived"] == 11056
+    assert m_on["wire_bytes_pinned"] == 11056
+    assert m_on["kinit_words_per_group"] * 4 == 11056
+    # The client delta the r09 probe published.
+    assert m_on["wire_bytes_derived"] - m_off["wire_bytes_derived"] == 2748
+
+
+def test_widened_bool_leaves_documented():
+    """Satellite: every i32-widened bool leaf is named by the derived
+    model, with the waste the r08 probe measured (~700 B/group at the
+    headline config: 230 bool words x 3 widening bytes = 690 B)."""
+    m = bytemodel.derived_wire_model(bytemodel.headline_cfg())
+    widened = set(m["widening"]["leaves"])
+    assert widened == {
+        "nodes.votes", "alive_prev",
+        "mailbox.rv_req_present", "mailbox.rv_resp_present",
+        "mailbox.rv_resp_granted", "mailbox.ae_req_present",
+        "mailbox.ae_resp_present", "mailbox.ae_resp_success",
+        "mailbox.is_req_present", "mailbox.is_resp_present",
+    }
+    assert m["widening"]["waste_bytes_per_group"] == 690
+    # Clients on adds no new bools (session tables are i32).
+    m_on = bytemodel.derived_wire_model(bytemodel.clients_cfg())
+    assert set(m_on["widening"]["leaves"]) == widened
+    # The ceiling the model publishes is the exact supported() boundary.
+    assert m["hbm"]["boundary_exact"]
+
+
+def test_audit_script_exits_zero(tmp_path):
+    """scripts/static_audit.py exits 0 on the current tree."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts", "static_audit.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "static audit ok" in proc.stdout
+    assert "8308" in proc.stdout and "11056" in proc.stdout
+
+
+# -------------------------------------------------------- synthetic drift
+
+
+def test_fake_state_leaf_is_named():
+    """Add a fake leaf to a copy of PerNode -> the auditor names it AND
+    the registry that missed it."""
+    problems = contracts.wire_registry_problems(
+        pernode_fields=PerNode._fields + ("ghost_leaf",))
+    assert problems, "fake PerNode leaf went undetected"
+    assert any("ghost_leaf" in p and "_node_leaves" in p for p in problems)
+
+
+def test_fake_mailbox_leaf_is_named():
+    problems = contracts.wire_registry_problems(
+        mailbox_fields=Mailbox._fields + ("xx_req_ghost",))
+    assert any("xx_req_ghost" in p and "_mb_fields" in p for p in problems)
+
+
+def test_fake_presence_leaf_trips_flight_contract():
+    """A new *_present mailbox bit missing from PRESENCE_FIELDS would
+    silently drop a message type from the flight recorder's volume
+    signal — the auditor catches the registry gap."""
+    problems = contracts.wire_registry_problems(
+        mailbox_fields=Mailbox._fields + ("zz_req_present",))
+    assert any("PRESENCE_FIELDS" in p and "zz_req_present" in p
+               for p in problems)
+
+
+def test_audit_script_nonzero_on_injected_drift():
+    """End-to-end rc path: the script must exit nonzero, naming the
+    injected leaf and the registry."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts", "static_audit.py"),
+         "--inject-drift", "ghost_leaf"],
+        capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "ghost_leaf" in proc.stdout
+    assert "_node_leaves" in proc.stdout
+
+
+class _NoBackfillCheckpoint:
+    """A drifted checkpoint implementation that forgot the pre-r07/r09
+    metric backfills: any file missing a Metrics leaf fails to load,
+    exactly what checkpoint.load looked like before the backfill rules
+    landed."""
+
+    save = staticmethod(checkpoint.save)
+
+    @staticmethod
+    def load(path, cfg=None, sharding=None):
+        from raft_tpu.sim.run import Metrics
+        with np.load(path) as z:
+            if "metrics.committed" in z.files:
+                for f in Metrics._fields:
+                    key = f"metrics.{f}"
+                    if key not in z.files and f not in (
+                            "client_acked", "client_retries",
+                            "client_hist", "client_max_lat"):
+                        raise KeyError(key)
+                if ("state.clients.done" in z.files
+                        and "metrics.client_acked" not in z.files):
+                    raise KeyError("metrics.client_acked")
+        path.seek(0)
+        return checkpoint.load(path, cfg=cfg, sharding=sharding)
+
+
+def test_dropped_checkpoint_backfill_detected():
+    """Drop the safety / client-lane backfills -> the auditor reports
+    the named backfill drift (and the script form would exit nonzero,
+    since any problem does)."""
+    problems = contracts.checkpoint_problems(
+        ckpt_mod=_NoBackfillCheckpoint)
+    assert any("pre-r07 backfill drift" in p for p in problems)
+    assert any("pre-r09 backfill drift" in p for p in problems)
+    # The real implementation passes the same pass cleanly.
+    assert contracts.checkpoint_problems() == []
+
+
+# ------------------------------------------------------------- purity lint
+
+
+def _lint_fixture(tmp_path, body, name="fixture.py", workload=False):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return lint.lint_file(str(p), workload_rules=workload)
+
+
+def test_lint_untagged_jax_random_names_file_line(tmp_path):
+    findings = _lint_fixture(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def draw(key, g):
+            return jax.random.uniform(key, (g,))
+    """)
+    hits = [f for f in findings if f.rule == "untagged-randomness"]
+    assert len(hits) == 1
+    assert hits[0].line == 6
+    assert hits[0].path.endswith("fixture.py")
+    assert "jax.random" in hits[0].message
+
+
+def test_lint_untagged_stdlib_random_import(tmp_path):
+    findings = _lint_fixture(tmp_path, """
+        import random
+
+        def f():
+            return random.random()
+    """)
+    assert any(f.rule == "untagged-randomness" and f.line == 2
+               for f in findings)
+
+
+def test_lint_traced_branch_named(tmp_path):
+    findings = _lint_fixture(tmp_path, """
+        import jax.numpy as jnp
+
+        def f(ns: PerNode, cfg):
+            if cfg.prevote:          # static gate: legal
+                x = jnp.sum(ns.term)
+                if x > 0:            # traced branch: illegal
+                    return 1
+            return 0
+    """)
+    hits = [f for f in findings if f.rule == "traced-branch"]
+    assert len(hits) == 1
+    assert hits[0].line == 7
+    assert "'x'" in hits[0].message and "f()" in hits[0].message
+
+
+def test_lint_nonelementwise_workload(tmp_path):
+    findings = _lint_fixture(tmp_path, """
+        import jax.numpy as jnp
+
+        def client_update(cfg, cs, tmax, g, sid, t):
+            acked = jnp.where(tmax >= cs.done, 1, 0)     # legal
+            return jnp.sum(acked, axis=1)                # lane-coupling
+    """, workload=True)
+    hits = [f for f in findings if f.rule == "non-elementwise-workload"]
+    assert len(hits) == 1
+    assert hits[0].line == 6
+    assert "jnp.sum" in hits[0].message
+
+
+def test_lint_clean_on_real_modules():
+    """The three contract-surface modules lint clean — the zero-noise
+    property every rule is tuned for."""
+    assert lint.lint_default() == []
+
+
+# ------------------------------------------------------------ parity alias
+
+
+def test_metric_parity_single_source():
+    """The parity script is a thin wrapper over the auditor's pass —
+    ONE source of truth (satellite: fold check_metric_parity into the
+    auditor)."""
+    sys.path.insert(0, os.path.join(_ROOT, "scripts"))
+    try:
+        import check_metric_parity
+    finally:
+        sys.path.pop(0)
+    assert check_metric_parity.check() == []
+    assert check_metric_parity.check.__module__ == "check_metric_parity"
+    # Both roads report through the same pass.
+    assert contracts.metric_parity_problems() == []
