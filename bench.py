@@ -32,9 +32,23 @@ Observability (DESIGN.md §8): both engines fold the per-tick safety bit
 segment and globally in the JSON), both carry the on-device flight
 recorder (dumped on any gate failure or safety violation), warmup
 (compile-inclusive) and steady-state walls are separate fields
-everywhere, and every segment appends a JSONL provenance manifest
-(config hash, jax/jaxlib versions, device, wall split, verdicts) to
-$RAFT_TPU_MANIFEST or ./bench_manifest.jsonl.
+everywhere (ONE normalized key set, `_wall_fields`), and every segment
+appends a JSONL provenance manifest (config hash, jax/jaxlib versions,
+device, wall split, verdicts) to $RAFT_TPU_MANIFEST or
+./bench_manifest.jsonl.
+
+Performance observability (DESIGN.md §12): every segment and manifest
+record is stamped with its roofline fields — `predicted_rounds_per_sec`
+(the HBM/FLOP-bound ceiling derived from the reconciled byte model +
+cost_analysis FLOPs), `attainment_pct` (null off-TPU; the prediction
+side runs anywhere), and `bound` — so each number says how close it
+sits to what the hardware allows. `--trace-dir DIR` writes a Chrome
+trace-event timeline (segment/warmup/timed/per-chunk spans, Perfetto-
+loadable) plus a soak-heartbeat JSONL (counters + flight-ring health
+every N chunks); `--jax-profile` adds a per-segment device-side
+profiler capture. `scripts/bench_history.py` folds the emitted
+manifests plus every BENCH_r*/MULTICHIP_* snapshot into one trajectory
+with a regression gate.
 """
 
 from __future__ import annotations
@@ -42,6 +56,7 @@ from __future__ import annotations
 import argparse
 import functools
 import json
+import os
 import sys
 import time
 
@@ -53,10 +68,14 @@ from raft_tpu import sim
 # sessions measured as client-visible SLO next to raw rounds/s.
 from raft_tpu.clients import exactly_once_report, workload_params
 from raft_tpu.config import RaftConfig
-# Observability layer (DESIGN.md §8): flight recorder rides both
-# engines; every segment emits a JSONL provenance manifest.
+# Observability layer (DESIGN.md §8/§12): flight recorder rides both
+# engines; every segment emits a JSONL provenance manifest stamped
+# with its roofline fields; --trace-dir adds Chrome trace-event spans
+# and the soak heartbeat.
 from raft_tpu.obs import (dump_flight, emit_manifest, flight_init,
                           run_recorded)
+from raft_tpu.obs import roofline as obs_roofline
+from raft_tpu.obs import trace as obs_trace
 from raft_tpu.sim.run import (latency_censored, latency_quantile,
                               metrics_init, total_client_ops,
                               total_client_retries, total_rounds,
@@ -131,23 +150,71 @@ def _mesh_fields(n_groups: int, nd: int) -> dict:
     return {"mesh_shape": [nd], "groups_per_device": -(-n_groups // nd)}
 
 
+# The canonical wall-clock key set every segment dict (and hence every
+# manifest record) carries — r07 grew `xla_wall_s`/`kernel_wall_s` on
+# the from-tick-0 segments while the steady-state segments said
+# `timed_wall_s`/`pallas_warmup_wall_s`, and the fault segment had no
+# `timed_wall_s` at all; one producer (`_wall_fields`), pinned by
+# tests/test_perf_obs.py, ends the drift. `timed_wall_s` is always the
+# PROMOTED engine's steady-state wall; nulls mean "that engine did not
+# run", never "unrecorded".
+SEGMENT_WALL_KEYS = ("timed_wall_s", "xla_wall_s", "xla_warmup_wall_s",
+                     "kernel_wall_s", "kernel_warmup_wall_s")
+
+
+def _wall_fields(timed_wall_s, xla_wall_s=None, xla_warmup_wall_s=None,
+                 kernel_wall_s=None, kernel_warmup_wall_s=None) -> dict:
+    """The ONE producer of the wall-clock split keys (see
+    SEGMENT_WALL_KEYS). Rounds to ms precision; None passes through."""
+    def r3(v):
+        return round(v, 3) if v is not None else None
+    return {"timed_wall_s": r3(timed_wall_s), "xla_wall_s": r3(xla_wall_s),
+            "xla_warmup_wall_s": r3(xla_warmup_wall_s),
+            "kernel_wall_s": r3(kernel_wall_s),
+            "kernel_warmup_wall_s": r3(kernel_warmup_wall_s)}
+
+
+# Filled by main() when --trace-dir is given: the Chrome trace file
+# this process will save, stamped into every segment/manifest record.
+_TRACE_PATH: str | None = None
+
+
+def _roofline_fields(cfg, n_groups: int, engine: str, ticks: int,
+                     timed_wall_s, nd: int = 1) -> dict:
+    """The roofline stamp every segment carries (DESIGN.md §12):
+    predicted_rounds_per_sec / attainment_pct / bound plus the full
+    derivation. The measured side is only meaningful against a real
+    TPU wall — on any other backend the prediction still runs and
+    attainment is null (the model stays testable on CPU boxes). The
+    FLOPs probe compiles one abstract tick; off-TPU that compile can
+    dwarf a --quick run on slow-compile boxes, so it is skipped there
+    unless $RAFT_TPU_ROOFLINE_FLOPS=1 opts in (bound degrades to the
+    hbm side, which is the binding resource for every XLA shape
+    anyway, DESIGN.md §7)."""
+    on_tpu = jax.devices()[0].platform == "tpu"
+    flops = on_tpu or os.environ.get("RAFT_TPU_ROOFLINE_FLOPS") == "1"
+    fields = obs_roofline.segment_fields(
+        cfg, n_groups, engine, ticks=ticks, timed_wall_s=timed_wall_s,
+        nd=nd, chunk_ticks=CHUNK, measured=on_tpu, flops=flops)
+    fields["trace_path"] = _TRACE_PATH
+    return fields
+
+
 def _gate_fields(label: str, pal, m_ref, f_ref, n_groups: int,
                  engine: str) -> dict:
-    """The verdict/wall/mesh-provenance fields every steady-state
-    segment shares (throughput / election-rounds / reads): the per-tick
-    safety verdict, the kernel promotion verdicts and compile-wall, and
-    the mesh fields for the engine that actually stood (`engine` equals
-    the kernel's own name only when it was promoted; any fallback means
-    the single-device XLA scan ran) — assembled once so the three
-    segment dicts cannot drift apart."""
+    """The verdict/mesh-provenance fields every steady-state segment
+    shares (throughput / election-rounds / reads): the per-tick safety
+    verdict, the kernel promotion verdicts, and the mesh fields for
+    the engine that actually stood (`engine` equals the kernel's own
+    name only when it was promoted; any fallback means the
+    single-device XLA scan ran) — assembled once so the three segment
+    dicts cannot drift apart. Wall keys live in `_wall_fields`."""
     unsafe = _safety_check(label, m_ref, f_ref, n_groups)
     nd_eff = pal["nd"] if engine == pal["engine"] else 1
     return {
         "state_identical": pal["state_identical"],
         "metrics_identical": pal["metrics_identical"],
         "flight_identical": pal["flight_identical"],
-        "pallas_warmup_wall_s": (round(pal["warmup_s"], 3)
-                                 if pal["warmup_s"] is not None else None),
         "safety_ok": unsafe == 0,
         "unsafe_groups": unsafe,
         **_mesh_fields(n_groups, nd_eff),
@@ -175,7 +242,8 @@ CHUNK = 200   # ticks per device call: one compiled program, reused
 
 
 def _timed_chunks(cfg, n_groups: int, ticks: int, counter_fn,
-                  warmup_chunks: int = 1):
+                  warmup_chunks: int = 1, label: str = "xla",
+                  chunk: int | None = None):
     """Shared warmup + chunked-timing harness for every counter-delta
     bench segment. Runs in fixed-size chunks so every timed device call
     reuses the one compiled (cfg, CHUNK, pytree-shape) program — the
@@ -191,28 +259,41 @@ def _timed_chunks(cfg, n_groups: int, ticks: int, counter_fn,
     compile-inclusive warmup wall; `elapsed_s` is steady-state only —
     the two are reported as SEPARATE fields everywhere (manifest +
     bench JSON) so compile cost can never blur into a throughput
-    number. The flight-recorder ring rides the scan in both phases."""
+    number. The flight-recorder ring rides the scan in both phases.
+
+    Observability (DESIGN.md §12): with a tracer installed the warmup
+    and timed regions are separate spans with one chunk-span per
+    device call, and the soak heartbeat (when installed) snapshots
+    metrics + flight-ring health after every timed chunk — a long run
+    is observable mid-flight."""
+    chunk = chunk or CHUNK
     st = sim.init(cfg, n_groups=n_groups)
     m = metrics_init(n_groups)
     f = flight_init(n_groups)
     t0 = time.perf_counter()
     tick_at = 0
-    for _ in range(warmup_chunks):
-        st, m, f = run_recorded(cfg, st, CHUNK, tick_at, m, f)
-        tick_at += CHUNK
-    jax.block_until_ready(st)
+    with obs_trace.span(f"warmup+compile xla [{label}]",
+                        warmup_chunks=warmup_chunks):
+        for _ in range(warmup_chunks):
+            with obs_trace.chunk_span("xla", tick_at, chunk, phase="warmup"):
+                st, m, f = run_recorded(cfg, st, chunk, tick_at, m, f)
+                tick_at += chunk
+        jax.block_until_ready(st)
     warmup_s = time.perf_counter() - t0
     log(f"  warmup {tick_at} ticks (incl. compile): {warmup_s:.1f}s")
     base = counter_fn(st, m)
-    n_chunks = max(1, ticks // CHUNK)
+    n_chunks = max(1, ticks // chunk)
     start = time.perf_counter()
-    for _ in range(n_chunks):
-        st, m, f = run_recorded(cfg, st, CHUNK, tick_at, m, f)
-        tick_at += CHUNK
-    jax.block_until_ready(st)
+    with obs_trace.span(f"timed xla [{label}]", n_chunks=n_chunks):
+        for _ in range(n_chunks):
+            with obs_trace.chunk_span("xla", tick_at, chunk, phase="timed"):
+                st, m, f = run_recorded(cfg, st, chunk, tick_at, m, f)
+                tick_at += chunk
+            obs_trace.heartbeat(label, tick_at, m, f)
+        jax.block_until_ready(st)
     elapsed = time.perf_counter() - start
     delta = counter_fn(st, m) - base
-    return (delta / elapsed, delta, elapsed, n_chunks * CHUNK, warmup_s,
+    return (delta / elapsed, delta, elapsed, n_chunks * chunk, warmup_s,
             st, m, f)
 
 
@@ -268,18 +349,24 @@ def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
             getattr(pkernel, counter_name), cfg)
         leaves, g = kinit(sim.init(cfg, n_groups=n_groups))
         t0 = time.perf_counter()
-        leaves = kstep(leaves, 0, CHUNK)
-        counter_fn(leaves, g)                            # forces compile #1
-        leaves = kstep(leaves, CHUNK, CHUNK)
-        base = counter_fn(leaves, g)                     # forces compile #2
+        with obs_trace.span(f"warmup+compile pallas [{what}]"):
+            leaves = kstep(leaves, 0, CHUNK)
+            counter_fn(leaves, g)                        # forces compile #1
+            leaves = kstep(leaves, CHUNK, CHUNK)
+            base = counter_fn(leaves, g)                 # forces compile #2
         warmup_s = time.perf_counter() - t0
         log(f"  [pallas] warmup {2 * CHUNK} ticks (incl. 2 compiles): "
             f"{warmup_s:.1f}s")
         n_chunks = timed_ticks // CHUNK
         start = time.perf_counter()
-        for c in range(n_chunks):
-            leaves = kstep(leaves, (c + 2) * CHUNK, CHUNK)
-        count = counter_fn(leaves, g) - base    # fetch closes the timer
+        with obs_trace.span(f"timed pallas [{what}]", n_chunks=n_chunks):
+            for c in range(n_chunks):
+                with obs_trace.chunk_span("pallas", (c + 2) * CHUNK, CHUNK,
+                                          phase="timed"):
+                    leaves = kstep(leaves, (c + 2) * CHUNK, CHUNK)
+                obs_trace.heartbeat_wire(f"pallas:{what}",
+                                         (c + 3) * CHUNK, cfg, leaves, g)
+            count = counter_fn(leaves, g) - base   # fetch closes the timer
         elapsed = time.perf_counter() - start
         rate = count / elapsed
         log(f"  [pallas{'' if nd == 1 else f' x{nd}dev'}] "
@@ -346,22 +433,27 @@ def _pallas_full_run(cfg, n_groups: int, ticks: int, counter_name: str,
             return out
         counter = functools.partial(getattr(pkernel, counter_name), cfg)
         t0 = time.perf_counter()
-        wl, wg = kinit(sim.init(cfg, n_groups=n_groups))
-        wl = kstep(wl, 0, CHUNK)
-        counter(wl, wg)
-        wl = kstep(wl, CHUNK, CHUNK)
-        counter(wl, wg)
+        with obs_trace.span(f"warmup+compile pallas [{label}]"):
+            wl, wg = kinit(sim.init(cfg, n_groups=n_groups))
+            wl = kstep(wl, 0, CHUNK)
+            counter(wl, wg)
+            wl = kstep(wl, CHUNK, CHUNK)
+            counter(wl, wg)
         out["k_warmup_s"] = time.perf_counter() - t0
         log(f"  [pallas] warmup (incl. 2 compiles): "
             f"{out['k_warmup_s']:.1f}s")
         leaves, g = kinit(sim.init(cfg, n_groups=n_groups))
         start = time.perf_counter()
-        at = 0
-        while at < ticks:
-            n = min(CHUNK, ticks - at)
-            leaves = kstep(leaves, at, n)
-            at += n
-        counter(leaves, g)   # fetch closes the timer
+        with obs_trace.span(f"timed pallas [{label}]"):
+            at = 0
+            while at < ticks:
+                n = min(CHUNK, ticks - at)
+                with obs_trace.chunk_span("pallas", at, n, phase="timed"):
+                    leaves = kstep(leaves, at, n)
+                at += n
+                obs_trace.heartbeat_wire(f"pallas:{label}", at, cfg,
+                                         leaves, g)
+            counter(leaves, g)   # fetch closes the timer
         out["k_elapsed"] = time.perf_counter() - start
         st_pal, m_pal = pkernel.kfinish(cfg, leaves, g)
         f_pal = pkernel.kflight(cfg, leaves, g)
@@ -411,11 +503,13 @@ def bench_throughput(n_groups: int, ticks: int):
     cfg = RaftConfig(seed=42)
     (rps, rounds, elapsed, timed_ticks, warmup_s, st_ref, m_ref,
      f_ref) = _timed_chunks(cfg, n_groups, ticks,
-                            lambda st, m: total_rounds(m))
+                            lambda st, m: total_rounds(m),
+                            label="throughput")
     log(f"  [xla] {n_groups} groups x {timed_ticks} ticks: {rounds} rounds "
         f"in {elapsed:.2f}s -> {rps:,.0f} rounds/s "
         f"({timed_ticks / elapsed:,.0f} ticks/s)")
     engine = "xla-scan"
+    x_elapsed = elapsed
     pal = _pallas_segment(cfg, n_groups, timed_ticks, "kcommitted",
                           st_ref, m_ref, f_ref, "rounds")
     if pal["status"] == "ok" and pal["rate"] > rps:
@@ -427,13 +521,17 @@ def bench_throughput(n_groups: int, ticks: int):
     seg = {
         "rounds_per_sec": round(rps, 1), "rounds": rounds,
         "ticks": timed_ticks, "engine": engine,
-        "timed_wall_s": round(elapsed, 3),
-        "xla_warmup_wall_s": round(warmup_s, 3),
         "pallas_rounds_per_sec": round(pal["rate"], 1) if ok else None,
         "pallas_ms_per_tick": (round(pal["elapsed"] / timed_ticks * 1e3, 3)
                                if ok else None),
+        **_wall_fields(elapsed, xla_wall_s=x_elapsed,
+                       xla_warmup_wall_s=warmup_s,
+                       kernel_wall_s=pal["elapsed"] if ok else None,
+                       kernel_warmup_wall_s=pal["warmup_s"]),
         **_gate_fields("throughput", pal, m_ref, f_ref, n_groups,
                        engine),
+        **_roofline_fields(cfg, n_groups, engine, timed_ticks, elapsed,
+                           nd=pal["nd"] if engine == pal["engine"] else 1),
     }
     emit_manifest("throughput", cfg, device=_device_str(),
                   n_groups=n_groups, **seg)
@@ -460,20 +558,24 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
     # --- XLA reference: warm the compile on a throwaway universe, then
     # time the real one end-to-end (the histogram needs every tick).
     t0 = time.perf_counter()
-    wst, wm, wf = run_recorded(cfg, sim.init(cfg, n_groups=n_groups),
-                               CHUNK, 0, metrics_init(n_groups),
-                               flight_init(n_groups))
-    jax.block_until_ready(wst)
+    with obs_trace.span(f"warmup+compile xla [{label}]"):
+        wst, wm, wf = run_recorded(cfg, sim.init(cfg, n_groups=n_groups),
+                                   CHUNK, 0, metrics_init(n_groups),
+                                   flight_init(n_groups))
+        jax.block_until_ready(wst)
     x_warmup_s = time.perf_counter() - t0
     log(f"  [xla] warmup chunk (incl. compile): {x_warmup_s:.1f}s")
     st = sim.init(cfg, n_groups=n_groups)
     m = metrics_init(n_groups)
     f = flight_init(n_groups)
     start = time.perf_counter()
-    for tick_at in range(0, ticks, CHUNK):
-        st, m, f = run_recorded(cfg, st, min(CHUNK, ticks - tick_at),
-                                tick_at, m, f)
-    n_elections = int(m.elections)          # fetch closes the timer
+    with obs_trace.span(f"timed xla [{label}]"):
+        for tick_at in range(0, ticks, CHUNK):
+            n = min(CHUNK, ticks - tick_at)
+            with obs_trace.chunk_span("xla", tick_at, n, phase="timed"):
+                st, m, f = run_recorded(cfg, st, n, tick_at, m, f)
+            obs_trace.heartbeat(label, tick_at + n, m, f)
+        n_elections = int(m.elections)      # fetch closes the timer
     x_elapsed = time.perf_counter() - start
     rounds = total_rounds(m)
     log(f"  [xla] {label} {n_groups} groups x {ticks} ticks in "
@@ -510,17 +612,17 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
         "state_identical": state_ok, "metrics_identical": metrics_ok,
         "flight_identical": flight_ok,
         "n_groups": n_groups, "ticks": ticks,
-        "xla_wall_s": round(x_elapsed, 3),
-        "xla_warmup_wall_s": round(x_warmup_s, 3),
-        "kernel_wall_s": (round(k_elapsed, 3)
-                          if k_elapsed is not None else None),
-        "kernel_warmup_wall_s": (round(k_warmup_s, 3)
-                                 if k_warmup_s is not None else None),
+        **_wall_fields(elapsed, xla_wall_s=x_elapsed,
+                       xla_warmup_wall_s=x_warmup_s,
+                       kernel_wall_s=k_elapsed,
+                       kernel_warmup_wall_s=k_warmup_s),
         "safety_ok": unsafe == 0, "unsafe_groups": unsafe,
         # Mesh provenance in the segment dict itself (not only the
         # manifest), matching the _gate_fields segments — the BENCH
         # JSON's fault entries must say their engine's device count too.
         **_mesh_fields(n_groups, nd if engine == k_name else 1),
+        **_roofline_fields(cfg, n_groups, engine, ticks, elapsed,
+                           nd=nd if engine == k_name else 1),
     }
     emit_manifest(label, cfg, device=_device_str(),
                   **{k: v for k, v in seg.items() if k != "p99_note"})
@@ -549,24 +651,31 @@ def bench_election_rounds(n_groups: int, ticks: int):
                      crash_epoch=32)
     (eps, elections, elapsed, timed_ticks, warmup_s, st_ref, m_ref,
      f_ref) = _timed_chunks(cfg, n_groups, ticks,
-                            lambda st, m: int(m.elections))
+                            lambda st, m: int(m.elections),
+                            label="election-rounds")
     log(f"  [xla] election rounds {n_groups} groups x {timed_ticks} ticks: "
         f"{elections} elections in {elapsed:.2f}s -> {eps:,.0f} elections/s")
     engine = "xla-scan"
+    x_elapsed = elapsed
     pal = _pallas_segment(cfg, n_groups, timed_ticks, "kelections",
                           st_ref, m_ref, f_ref, "elections")
     if pal["status"] == "ok" and pal["rate"] > eps:
-        eps, elections = pal["rate"], pal["count"]
+        eps, elections, elapsed = pal["rate"], pal["count"], pal["elapsed"]
         engine = pal["engine"]
     elif pal["status"] == "mismatch":
         engine = "xla-scan (pallas mismatch!)"
+    ok = pal["status"] == "ok"
     seg = {
         "elections_per_sec": round(eps, 1), "elections": elections,
         "engine": engine,
-        "timed_wall_s": round(elapsed, 3),
-        "xla_warmup_wall_s": round(warmup_s, 3),
+        **_wall_fields(elapsed, xla_wall_s=x_elapsed,
+                       xla_warmup_wall_s=warmup_s,
+                       kernel_wall_s=pal["elapsed"] if ok else None,
+                       kernel_warmup_wall_s=pal["warmup_s"]),
         **_gate_fields("election-rounds", pal, m_ref, f_ref, n_groups,
                        engine),
+        **_roofline_fields(cfg, n_groups, engine, timed_ticks, elapsed,
+                           nd=pal["nd"] if engine == pal["engine"] else 1),
     }
     emit_manifest("election-rounds", cfg, device=_device_str(),
                   n_groups=n_groups, ticks=timed_ticks, **seg)
@@ -588,23 +697,29 @@ def bench_reads(n_groups: int, ticks: int):
      f_ref) = _timed_chunks(
         cfg, n_groups, ticks,
         lambda st, m: int(np.asarray(st.nodes.reads_done)
-                          .astype(np.int64).sum()))
+                          .astype(np.int64).sum()), label="reads")
     log(f"  [xla] linearizable reads {n_groups} groups x {timed_ticks} "
         f"ticks (read_every={cfg.read_every}): {reads} reads in "
         f"{elapsed:.2f}s -> {rps:,.0f} reads/s")
     engine = "xla-scan"
+    x_elapsed = elapsed
     pal = _pallas_segment(cfg, n_groups, timed_ticks, "kreads",
                           st_ref, m_ref, f_ref, "reads")
     if pal["status"] == "ok" and pal["rate"] > rps:
-        rps, reads = pal["rate"], pal["count"]
+        rps, reads, elapsed = pal["rate"], pal["count"], pal["elapsed"]
         engine = pal["engine"]
     elif pal["status"] == "mismatch":
         engine = "xla-scan (pallas mismatch!)"
+    ok = pal["status"] == "ok"
     seg = {
         "reads_per_sec": round(rps, 1), "reads": reads, "engine": engine,
-        "timed_wall_s": round(elapsed, 3),
-        "xla_warmup_wall_s": round(warmup_s, 3),
+        **_wall_fields(elapsed, xla_wall_s=x_elapsed,
+                       xla_warmup_wall_s=warmup_s,
+                       kernel_wall_s=pal["elapsed"] if ok else None,
+                       kernel_warmup_wall_s=pal["warmup_s"]),
         **_gate_fields("reads", pal, m_ref, f_ref, n_groups, engine),
+        **_roofline_fields(cfg, n_groups, engine, timed_ticks, elapsed,
+                           nd=pal["nd"] if engine == pal["engine"] else 1),
     }
     emit_manifest("reads", cfg, device=_device_str(), n_groups=n_groups,
                   ticks=timed_ticks, **seg)
@@ -637,21 +752,25 @@ def bench_clients(seed: int, n_groups: int, ticks: int, label: str):
                      crash_prob=0.3, crash_epoch=64,
                      partition_prob=0.2, partition_epoch=64, drop_prob=0.02)
     t0 = time.perf_counter()
-    wst, _, _ = run_recorded(cfg, sim.init(cfg, n_groups=n_groups),
-                             CHUNK, 0,
-                             metrics_init(n_groups, clients=True),
-                             flight_init(n_groups))
-    jax.block_until_ready(wst)
+    with obs_trace.span(f"warmup+compile xla [{label}]"):
+        wst, _, _ = run_recorded(cfg, sim.init(cfg, n_groups=n_groups),
+                                 CHUNK, 0,
+                                 metrics_init(n_groups, clients=True),
+                                 flight_init(n_groups))
+        jax.block_until_ready(wst)
     x_warmup_s = time.perf_counter() - t0
     log(f"  [xla] warmup chunk (incl. compile): {x_warmup_s:.1f}s")
     st = sim.init(cfg, n_groups=n_groups)
     m = metrics_init(n_groups, clients=True)
     f = flight_init(n_groups)
     start = time.perf_counter()
-    for tick_at in range(0, ticks, CHUNK):
-        st, m, f = run_recorded(cfg, st, min(CHUNK, ticks - tick_at),
-                                tick_at, m, f)
-    acked = total_client_ops(m)             # fetch closes the timer
+    with obs_trace.span(f"timed xla [{label}]"):
+        for tick_at in range(0, ticks, CHUNK):
+            n = min(CHUNK, ticks - tick_at)
+            with obs_trace.chunk_span("xla", tick_at, n, phase="timed"):
+                st, m, f = run_recorded(cfg, st, n, tick_at, m, f)
+            obs_trace.heartbeat(label, tick_at + n, m, f)
+        acked = total_client_ops(m)         # fetch closes the timer
     x_elapsed = time.perf_counter() - start
     retries = total_client_retries(m)
     log(f"  [xla] {label} {n_groups} groups x {ticks} ticks in "
@@ -692,30 +811,66 @@ def bench_clients(seed: int, n_groups: int, ticks: int, label: str):
         "state_identical": state_ok, "metrics_identical": metrics_ok,
         "flight_identical": flight_ok,
         "n_groups": n_groups, "ticks": ticks,
-        "timed_wall_s": round(elapsed, 3),
-        "xla_wall_s": round(x_elapsed, 3),
-        "xla_warmup_wall_s": round(x_warmup_s, 3),
-        "kernel_wall_s": (round(k_elapsed, 3)
-                          if k_elapsed is not None else None),
-        "kernel_warmup_wall_s": (round(k_warmup_s, 3)
-                                 if k_warmup_s is not None else None),
+        **_wall_fields(elapsed, xla_wall_s=x_elapsed,
+                       xla_warmup_wall_s=x_warmup_s,
+                       kernel_wall_s=k_elapsed,
+                       kernel_warmup_wall_s=k_warmup_s),
         "safety_ok": unsafe == 0, "unsafe_groups": unsafe,
         # Workload provenance (ISSUE r09): every client segment's
         # manifest records the open-loop parameters it measured.
         "workload": workload_params(cfg),
         **_mesh_fields(n_groups, nd if engine == k_name else 1),
+        **_roofline_fields(cfg, n_groups, engine, ticks, elapsed,
+                           nd=nd if engine == k_name else 1),
     }
     emit_manifest(label, cfg, device=_device_str(), **seg)
     return seg
 
 
 def main():
+    global _TRACE_PATH
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="small shapes for a smoke run")
     ap.add_argument("--groups", type=int, default=None,
                     help="override the throughput-run group count")
+    ap.add_argument("--trace-dir", default=None,
+                    help="write a Chrome trace-event timeline "
+                         "(trace_bench.json, Perfetto-loadable) and the "
+                         "soak heartbeat JSONL into this directory")
+    ap.add_argument("--jax-profile", action="store_true",
+                    help="additionally capture a jax.profiler trace per "
+                         "segment under --trace-dir/jaxprof (large; "
+                         "opt-in)")
+    ap.add_argument("--heartbeat-every", type=int, default=10,
+                    help="chunks between soak-heartbeat snapshots "
+                         "(with --trace-dir; default 10)")
     args = ap.parse_args()
+
+    tracer = None
+    if args.trace_dir:
+        os.makedirs(args.trace_dir, exist_ok=True)
+        tracer = obs_trace.Tracer()
+        obs_trace.set_tracer(tracer)
+        _TRACE_PATH = os.path.join(args.trace_dir, "trace_bench.json")
+        obs_trace.set_heartbeat(obs_trace.Heartbeat(
+            os.path.join(args.trace_dir, "heartbeat.jsonl"),
+            every=args.heartbeat_every))
+        log(f"tracing to {_TRACE_PATH} (heartbeat every "
+            f"{args.heartbeat_every} chunks; NOTE: heartbeat snapshots "
+            f"sync the device mid-segment — walls include that cost)")
+
+    def segment(label, fn, *fargs):
+        """One bench segment under its span (+ optional jax.profiler
+        capture — device-side detail next to the host spans)."""
+        import contextlib
+        prof = contextlib.nullcontext()
+        if args.jax_profile and args.trace_dir:
+            prof = jax.profiler.trace(
+                os.path.join(args.trace_dir, "jaxprof",
+                             label.replace(" ", "_")))
+        with obs_trace.span(label, cat=obs_trace.CAT_SEGMENT), prof:
+            return fn(*fargs)
 
     # Pre-flight engine-contract audit (DESIGN.md §11): eval_shape
     # traces + AST parses only — no device programs. A drifted wire
@@ -749,19 +904,49 @@ def main():
         rd_groups, rd_ticks = 50_000, 600   # ReadIndex-at-scale segment
         cl_groups, cl_ticks = 50_000, 600   # client-SLO-at-scale segment
 
-    log(f"throughput (config-5 shape, {groups} x 5-node groups):")
-    tp = bench_throughput(groups, ticks)
-    log("election latency (config-4 shape, both engines):")
-    c4 = bench_fault_latency(43, e_groups, e_ticks, "config-4 fault run")
-    log("fault-mix throughput + latency (config-5 shape, both engines):")
-    c5f = bench_fault_latency(46, f_groups, f_ticks, "config-5 fault mix")
-    log("election rounds (config-2 shape):")
-    c2 = bench_election_rounds(r_groups, r_ticks)
-    log("linearizable reads (config-5 shape + ReadIndex schedule):")
-    rd = bench_reads(rd_groups, rd_ticks)
-    log("client traffic SLO (config-5 fault mix + open-loop exactly-once "
-        "sessions, both engines):")
-    cl = bench_clients(47, cl_groups, cl_ticks, "client-slo fault mix")
+    # The trace must survive a mid-run crash: a bench that dies in
+    # segment 5 of 6 is exactly the run whose timeline is needed, so
+    # the save rides a finally, not the happy path.
+    try:
+        log(f"throughput (config-5 shape, {groups} x 5-node groups):")
+        tp = segment("throughput", bench_throughput, groups, ticks)
+        log("election latency (config-4 shape, both engines):")
+        c4 = segment("config-4 fault run", bench_fault_latency, 43,
+                     e_groups, e_ticks, "config-4 fault run")
+        log("fault-mix throughput + latency (config-5 shape, both "
+            "engines):")
+        c5f = segment("config-5 fault mix", bench_fault_latency, 46,
+                      f_groups, f_ticks, "config-5 fault mix")
+        log("election rounds (config-2 shape):")
+        c2 = segment("election-rounds", bench_election_rounds, r_groups,
+                     r_ticks)
+        log("linearizable reads (config-5 shape + ReadIndex schedule):")
+        rd = segment("reads", bench_reads, rd_groups, rd_ticks)
+        log("client traffic SLO (config-5 fault mix + open-loop "
+            "exactly-once sessions, both engines):")
+        cl = segment("client-slo fault mix", bench_clients, 47, cl_groups,
+                     cl_ticks, "client-slo fault mix")
+
+        # Roofline contract (DESIGN.md §12, ISSUE r12 acceptance): every
+        # segment must carry the three stamp fields — a segment emitted
+        # without them would publish a number that cannot explain itself.
+        for name, seg in (("throughput", tp), ("config-4", c4),
+                          ("config-5-faults", c5f),
+                          ("election-rounds", c2), ("reads", rd),
+                          ("client-slo", cl)):
+            missing = [k for k in obs_roofline.ROOFLINE_FIELDS
+                       if k not in seg]
+            missing += [k for k in SEGMENT_WALL_KEYS if k not in seg]
+            if missing:
+                raise RuntimeError(
+                    f"bench segment {name!r} lost contract field(s) "
+                    f"{missing} — roofline/wall stamping drifted")
+    finally:
+        if tracer is not None:
+            obs_trace.set_heartbeat(None)
+            obs_trace.set_tracer(None)
+            log(f"trace: {len(tracer.events)} events -> "
+                f"{tracer.save(_TRACE_PATH)}")
 
     # The client segment's per-segment exactly-once verdict (per-tick
     # fold AND endpoint accounting) folds into the global safety bit:
@@ -785,9 +970,16 @@ def main():
         "engine": tp["engine"],
         "pallas_rounds_per_sec": tp["pallas_rounds_per_sec"],
         "pallas_ms_per_tick": tp["pallas_ms_per_tick"],
-        "pallas_warmup_wall_s": tp["pallas_warmup_wall_s"],
+        "pallas_warmup_wall_s": tp["kernel_warmup_wall_s"],
         "throughput_state_identical": tp["state_identical"],
         "throughput_safety_ok": tp["safety_ok"],
+        # Roofline stamp (DESIGN.md §12): the headline's predicted
+        # HBM/FLOP-bound ceiling, how much of it the promoted engine
+        # attained, and which resource binds. Null attainment = no TPU
+        # wall to measure against (prediction still stands).
+        "predicted_rounds_per_sec": tp["predicted_rounds_per_sec"],
+        "attainment_pct": tp["attainment_pct"],
+        "bound": tp["bound"],
         # Per-tick safety fold (DESIGN.md §8): every segment is a
         # (groups x ticks x k)-node-tick soak; True = no group violated
         # election safety / digest agreement / window bounds at ANY tick.

@@ -569,6 +569,66 @@ def checkpoint_problems(ckpt_mod=None,
     return problems
 
 
+# ------------------------------------------------------- manifest schema
+
+
+def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
+    """Manifest-record coverage for the r12 observability keys
+    (DESIGN.md §12): every record `emit_manifest` writes must carry the
+    roofline/trace keys from birth (null until a caller fills them,
+    like the r08 mesh keys), `obs.history.backfill_record` must add
+    exactly those keys as null onto a pre-r12 record, and caller-filled
+    values must survive emission and backfill untouched. Pass a drifted
+    module to prove the auditor names it — the synthetic-drift hook."""
+    from raft_tpu.obs import history as real_history
+    from raft_tpu.obs import manifest as real_manifest
+
+    man = real_manifest if manifest_mod is None else manifest_mod
+    hist = real_history if history_mod is None else history_mod
+    problems = []
+    keys = real_manifest.ROOFLINE_KEYS
+    if tuple(real_history.R12_MANIFEST_KEYS) != tuple(keys):
+        problems.append(
+            f"obs.history.R12_MANIFEST_KEYS {real_history.R12_MANIFEST_KEYS}"
+            f" != obs.manifest.ROOFLINE_KEYS {keys} — the emit-side and "
+            f"backfill-side key lists drifted")
+    rec = man.emit_manifest("audit-probe", _base_cfg(), path="-")
+    for k in keys + ("mesh_shape", "groups_per_device"):
+        if k not in rec:
+            problems.append(
+                f"manifest record missing default key {k!r} — a reader "
+                f"cannot distinguish 'unstamped' from 'pre-r12 schema'")
+        elif rec[k] is not None:
+            problems.append(
+                f"manifest default for {k!r} is {rec[k]!r}, not null — "
+                f"an unstamped record would claim a value")
+    # Caller-filled roofline values must survive emission.
+    rec2 = man.emit_manifest("audit-probe", _base_cfg(), path="-",
+                             bound="hbm", attainment_pct=12.5,
+                             predicted_rounds_per_sec=1.0)
+    for k, want in (("bound", "hbm"), ("attainment_pct", 12.5),
+                    ("predicted_rounds_per_sec", 1.0)):
+        if rec2.get(k) != want:
+            problems.append(f"manifest dropped the caller's {k!r} value "
+                            f"({rec2.get(k)!r} != {want!r})")
+    # Pre-r12 backfill: strip the keys, the history reader re-adds them
+    # present-but-null without touching anything else.
+    old = {k: v for k, v in rec.items() if k not in keys}
+    back = hist.backfill_record(old)
+    for k in keys:
+        if k not in back:
+            problems.append(f"history.backfill_record leaves a pre-r12 "
+                            f"record without {k!r}")
+        elif back[k] is not None:
+            problems.append(f"history.backfill_record invents a value for "
+                            f"{k!r} ({back[k]!r}) on a pre-r12 record")
+    changed = {k for k in old if back.get(k) != old[k]}
+    if changed:
+        problems.append(f"history.backfill_record rewrote pre-existing "
+                        f"manifest fields {sorted(changed)}")
+    return problems
+
+
 # ------------------------------------------------------------- rng parity
 
 
@@ -612,5 +672,6 @@ def contract_problems(include_behavioral: bool = True) -> list[str]:
     out += gating_problems()
     out += shard_rule_problems()
     out += checkpoint_problems(include_behavioral=include_behavioral)
+    out += manifest_problems()
     out += rng_parity_problems()
     return out

@@ -1,6 +1,6 @@
-"""Observability layer over both engines (DESIGN.md §8).
+"""Observability layer over both engines (DESIGN.md §8, §12).
 
-Four pieces, one evidence chain:
+Seven pieces, one evidence chain:
 
 - **Per-tick safety fold** — `check.tick_safety` ANDed into
   `Metrics.safety` every tick by `run.metrics_update` and, on the
@@ -14,17 +14,34 @@ Four pieces, one evidence chain:
   then names the first divergent leaf (utils.trees).
 - **Run manifests** (`obs.manifest`) — every bench segment appends one
   JSONL provenance record (config hash, versions, device, compile-vs-
-  run wall split, safety/identity verdicts).
+  run wall split, safety/identity verdicts, roofline stamp).
+- **Roofline model** (`obs.roofline`, §12) — the HBM/FLOP-bound
+  rounds/s ceiling per (cfg, G, engine), derived from the auditor's
+  reconciled byte model + `cost_analysis()`; every published number
+  carries `predicted_rounds_per_sec` / `attainment_pct` / `bound`.
+- **Timeline tracer + soak heartbeat** (`obs.trace`, §12) — Chrome
+  trace-event spans over segments/warmups/chunks (Perfetto-loadable),
+  plus a JSONL health snapshot every N chunks during long soaks.
+- **Bench history** (`obs.history`, §12) — every BENCH_r*/MULTICHIP_*/
+  manifest record normalized into one trajectory with a regression
+  gate (`scripts/bench_history.py`).
 """
 
-from raft_tpu.obs.manifest import config_hash, emit_manifest
+from raft_tpu.obs import history, roofline, trace
+from raft_tpu.obs.manifest import ROOFLINE_KEYS, config_hash, emit_manifest
 from raft_tpu.obs.recorder import (FLIGHT_LEAVES, RING, Flight, dump_flight,
                                    flight_init, flight_rows, flight_update,
                                    run_recorded)
+from raft_tpu.obs.trace import (Heartbeat, Tracer, chunk_span, heartbeat,
+                                heartbeat_wire, set_heartbeat, set_tracer,
+                                span, validate_trace)
 from raft_tpu.obs.triage import bisect_divergence
 
 __all__ = [
-    "FLIGHT_LEAVES", "RING", "Flight", "bisect_divergence", "config_hash",
+    "FLIGHT_LEAVES", "RING", "ROOFLINE_KEYS", "Flight", "Heartbeat",
+    "Tracer", "bisect_divergence", "chunk_span", "config_hash",
     "dump_flight", "emit_manifest", "flight_init", "flight_rows",
-    "flight_update", "run_recorded",
+    "flight_update", "heartbeat", "heartbeat_wire", "history", "roofline",
+    "run_recorded", "set_heartbeat", "set_tracer", "span", "trace",
+    "validate_trace",
 ]

@@ -1,0 +1,310 @@
+"""Bench-history normalization + regression tracking: every BENCH_r*,
+MULTICHIP_* and bench_manifest.jsonl record parsed into ONE trajectory,
+with a per-segment trend table and a threshold gate (DESIGN.md §12).
+
+The repo has carried five BENCH_r0N.json snapshots recording a
+7.2M -> 5.1M rounds/s XLA fade (r02 -> r04) that nothing read: the
+trajectory existed on disk but was invisible. This module is the
+reader. It normalizes three source shapes into one row schema::
+
+    {"source": file, "round": N or None, "segment": str,
+     "engine": "xla" | "pallas", "unit": "rounds/s" | ...,
+     "value": float, "n_groups": int | None, "extra": {...}}
+
+- **BENCH_rNN.json** driver snapshots: the ``parsed`` bench JSON line
+  (headline + per-segment rates) PLUS the stderr ``tail`` — the tail
+  carries the per-engine ``[xla] ... -> N rounds/s`` lines, which is
+  the only place the XLA rate survives once the kernel takes the
+  headline (r05+), so both are parsed and tail rows fill engines the
+  JSON no longer exposes.
+- **MULTICHIP_*.json** sweep grids: only ``promoted`` cells are
+  throughput claims (CPU dryrun/interpret cells are correctness-only
+  by construction — their wall times are compile-bound); unpromoted
+  cells are counted, not trended.
+- **bench_manifest.jsonl** provenance records: one row per rate-
+  bearing segment record. Pre-r12 records predate the roofline/trace
+  keys; `backfill_record` makes them present-but-null so every
+  consumer sees one schema (the analysis auditor proves this backfill
+  and the emit-side default agree).
+
+Series identity is (segment, engine, unit): the headline shape moved
+50K -> 100K groups at r03, and rounds/s is a per-chip figure both
+shapes saturate, so group count is REPORTED per row but does not split
+the series — exactly the comparison the ISSUE's r02->r05 XLA fade
+needs. The gate: for each series, the LATEST value against the best
+ancestor; a drop beyond ``threshold`` is a regression (latency-like
+units invert: a rise is the regression).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+# One engine-classification rule with the roofline model — a string
+# handled by one consumer but not the other would misfile a series
+# here while mis-pricing its ceiling there.
+from raft_tpu.obs.roofline import engine_class  # noqa: F401  (re-export)
+
+# Manifest keys added by the r12 observability layer — present (null
+# until filled) on every record emit_manifest writes from r12 on, and
+# backfilled as null onto older records by `backfill_record`. Declared
+# as this module's own literal (the repo's registry idiom) and proven
+# equal to the emit side's obs.manifest.ROOFLINE_KEYS by the auditor's
+# manifest pass (analysis/contracts.py).
+R12_MANIFEST_KEYS = ("predicted_rounds_per_sec", "attainment_pct",
+                     "bound", "trace_path")
+
+# Manifest records below this group count are smoke/--quick shapes:
+# correctness drives, not trajectory points — a 1K-group quick run's
+# rate joining the 100K series would trip (or mask) the regression
+# gate on every segment. The smallest real headline shape in the
+# checked-in history is the 10K-group config-2 segment.
+QUICK_GROUP_FLOOR = 10_000
+
+# parsed-JSON rate keys -> (segment, engine-key, n_groups-key, unit)
+_PARSED_RATES = (
+    ("value", "throughput", "engine", "n_groups", "rounds/s"),
+    ("faulted_rounds_per_sec", "config5-faults", "config5_fault_engine",
+     "config5_fault_n_groups", "rounds/s"),
+    ("elections_per_sec", "config2-elections", "config2_engine", None,
+     "elections/s"),
+    ("linearizable_reads_per_sec", "reads", "reads_engine", None,
+     "reads/s"),
+    ("client_ops_per_sec", "client-slo", "client_engine", None, "ops/s"),
+)
+
+# manifest segment-name -> (rate key, unit)
+_MANIFEST_RATES = {
+    "throughput": ("rounds_per_sec", "rounds/s"),
+    "config-4 fault run": ("rounds_per_sec", "rounds/s"),
+    "config-5 fault mix": ("rounds_per_sec", "rounds/s"),
+    "election-rounds": ("elections_per_sec", "elections/s"),
+    "reads": ("reads_per_sec", "reads/s"),
+    "client-slo fault mix": ("client_ops_per_sec", "ops/s"),
+}
+
+# One stderr tail line with a measured rate, either engine-tagged
+# ("[xla] 100000 groups x 600 ticks: ... -> 7,802,521 rounds/s") or
+# untagged pre-r05 ("  50000 groups x 600 ticks: ... -> 7,182,986
+# rounds/s", engine implicitly the XLA scan).
+_TAIL_RE = re.compile(
+    r"(?:\[(?P<eng>xla|pallas)[^\]]*\]\s*)?"
+    r"(?:election rounds |linearizable reads )?"
+    r"(?P<groups>\d[\d,]*) groups x (?P<ticks>\d+) ticks[^\n>]*"
+    r"-> (?P<rate>[\d,]+) (?P<unit>rounds|elections|reads|ops)/s")
+
+_UNIT_SEGMENT = {"rounds": "throughput", "elections": "config2-elections",
+                 "reads": "reads", "ops": "client-slo"}
+
+
+def _round_of(path: str) -> int | None:
+    m = re.search(r"_r(\d+)\.json", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def backfill_record(rec: dict) -> dict:
+    """A manifest record normalized to the r12 schema: the roofline/
+    trace keys present-but-null when the record predates them (same
+    rule as the mesh keys at r08). Returns a new dict."""
+    out = dict(rec)
+    for k in R12_MANIFEST_KEYS:
+        out.setdefault(k, None)
+    return out
+
+
+def _row(source, rnd, segment, engine, unit, value, n_groups,
+         **extra) -> dict:
+    return {"source": os.path.basename(str(source)), "round": rnd,
+            "segment": segment, "engine": engine_class(engine),
+            "unit": unit, "value": float(value),
+            "n_groups": int(n_groups) if n_groups is not None else None,
+            "extra": extra}
+
+
+def parse_bench_file(path: str) -> list[dict]:
+    """Rows from one BENCH_rNN.json driver snapshot (parsed JSON line +
+    stderr tail; tail rows only fill (segment, engine) points the
+    parsed line does not already cover)."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    rnd = _round_of(path) or doc.get("n")
+    rows: list[dict] = []
+    parsed = doc.get("parsed") or {}
+    for key, segment, eng_key, g_key, unit in _PARSED_RATES:
+        if parsed.get(key) is None:
+            continue
+        engine = parsed.get(eng_key) if eng_key else None
+        n_groups = parsed.get(g_key) if g_key else None
+        rows.append(_row(path, rnd, segment, engine, unit, parsed[key],
+                         n_groups, from_="parsed"))
+    seen = {(r["segment"], r["engine"]) for r in rows}
+    for m in _TAIL_RE.finditer(doc.get("tail") or ""):
+        segment = _UNIT_SEGMENT[m.group("unit")]
+        engine = m.group("eng") or "xla"
+        # XLA tail lines only: a "[pallas] ... -> N/s" line is logged
+        # BEFORE the promotion differential, so on a mismatch the tail
+        # carries the very rate the bench refused to publish; promoted
+        # kernel numbers always reach the parsed JSON (value/engine +
+        # the per-segment rate keys), so nothing real is lost.
+        if engine_class(engine) == "pallas":
+            continue
+        if (segment, engine_class(engine)) in seen:
+            continue
+        seen.add((segment, engine_class(engine)))
+        rows.append(_row(path, rnd, segment, engine,
+                         m.group("unit") + "/s",
+                         float(m.group("rate").replace(",", "")),
+                         int(m.group("groups").replace(",", "")),
+                         from_="tail"))
+    return rows
+
+
+def parse_multichip_file(path: str) -> list[dict]:
+    """Rows from a MULTICHIP_*.json sweep: promoted cells only (the
+    rest are correctness gates, not rates); unpromoted counts ride in
+    a zero-row summary extra for the table footer."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    rnd = _round_of(path)
+    rows = []
+    for cell in doc.get("grid", []):
+        if not cell.get("promoted"):
+            continue
+        wall = cell.get("wall_s")
+        rounds = cell.get("rounds")
+        if not wall or rounds is None:
+            continue
+        rows.append(_row(
+            path, rnd, f"multichip-{cell['devices']}dev",
+            cell.get("run", {}).get("engine", "pallas"), "rounds/s",
+            rounds / wall, cell.get("groups"), devices=cell["devices"]))
+    return rows
+
+
+def parse_manifest_file(path: str) -> list[dict]:
+    """Rows from a bench_manifest.jsonl: one per rate-bearing segment
+    record, ordered (and "round"-less — unix_time is the axis), each
+    record backfilled to the r12 key schema first.
+
+    Comparability filter: only TPU records at real shapes join the
+    trajectory. A CPU dev-box run or a --quick smoke
+    (n_groups < QUICK_GROUP_FLOOR) appends manifest records too — by
+    the sort rule those would always become a series' LATEST point and
+    trip the regression gate with a ~99% "drop" against the TPU best
+    (or, worse, mask a real one). Skips are announced on stderr, never
+    silent — a reader must know the trajectory excluded records."""
+    rows = []
+    skipped = 0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = backfill_record(json.loads(line))
+            except json.JSONDecodeError:
+                continue   # a torn append must not kill the reader
+            seg = rec.get("segment")
+            rate = _MANIFEST_RATES.get(seg)
+            if rate is None or rec.get(rate[0]) is None:
+                continue
+            dev = rec.get("device") or ""
+            g = rec.get("n_groups")
+            if not dev.startswith("tpu") or (g is not None
+                                             and g < QUICK_GROUP_FLOOR):
+                skipped += 1
+                continue
+            rows.append(_row(path, None, seg, rec.get("engine"), rate[1],
+                             rec[rate[0]], g,
+                             unix_time=rec.get("unix_time"),
+                             attainment_pct=rec.get("attainment_pct"),
+                             bound=rec.get("bound")))
+    if skipped:
+        import sys
+        print(f"[bench-history] {os.path.basename(str(path))}: skipped "
+              f"{skipped} non-TPU/smoke-shape record(s) — not trajectory "
+              f"points", file=sys.stderr)
+    return rows
+
+
+def load_history(root: str = ".", manifest: str | None = None
+                 ) -> list[dict]:
+    """Every row from `root`'s BENCH_r*.json + MULTICHIP_*.json plus
+    the manifest JSONL ($RAFT_TPU_MANIFEST / bench_manifest.jsonl /
+    explicit path), sorted by (segment, engine, round)."""
+    rows: list[dict] = []
+    for p in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        rows += parse_bench_file(p)
+    for p in sorted(glob.glob(os.path.join(root, "MULTICHIP_*.json"))):
+        rows += parse_multichip_file(p)
+    mpath = manifest or os.environ.get("RAFT_TPU_MANIFEST") \
+        or os.path.join(root, "bench_manifest.jsonl")
+    if mpath != "-" and os.path.exists(mpath):
+        rows += parse_manifest_file(mpath)
+    rows.sort(key=lambda r: (r["segment"], r["engine"],
+                             r["round"] if r["round"] is not None else 1e9,
+                             r["extra"].get("unix_time") or 0))
+    return rows
+
+
+def series(rows: list[dict]) -> dict:
+    """rows grouped by series identity (segment, engine, unit), order
+    preserved."""
+    out: dict = {}
+    for r in rows:
+        out.setdefault((r["segment"], r["engine"], r["unit"]),
+                       []).append(r)
+    return out
+
+
+def trend_table(rows: list[dict]) -> str:
+    """The human trajectory: one block per series, one line per point,
+    with delta vs the previous point and vs the best ancestor — the
+    r01->r05 XLA fade becomes visible output."""
+    lines = []
+    for (segment, engine, unit), pts in sorted(series(rows).items()):
+        lines.append(f"{segment} [{engine}] ({unit})")
+        best = None
+        for i, r in enumerate(pts):
+            rnd = (f"r{r['round']:02d}" if r["round"] is not None
+                   else "manif")
+            d_prev = d_best = ""
+            if best is not None:
+                prev = pts[i - 1]["value"]
+                d_prev = f"{100 * (r['value'] - prev) / prev:+7.1f}% prev"
+                d_best = f"{100 * (r['value'] - best) / best:+7.1f}% best"
+            g = f"{r['n_groups']:>7}" if r["n_groups"] else "      ?"
+            lines.append(f"  {rnd}  {g} groups  {r['value']:>14,.1f}  "
+                         f"{d_prev:>14}  {d_best:>14}")
+            best = r["value"] if best is None else max(best, r["value"])
+        lines.append("")
+    return "\n".join(lines)
+
+
+def regressions(rows: list[dict], threshold: float = 0.15) -> list[dict]:
+    """Series whose LATEST point dropped more than `threshold` below
+    its best ancestor. Rates regress downward; a series whose unit ends
+    in "ticks" (latency) would regress upward — none are trended today,
+    the guard documents the rule for whoever adds one."""
+    out = []
+    for (segment, engine, unit), pts in sorted(series(rows).items()):
+        if len(pts) < 2:
+            continue
+        latest = pts[-1]
+        best = max(pts[:-1], key=lambda r: r["value"])
+        if unit.endswith("ticks"):
+            continue   # latency trending needs an inverted rule
+        drop = (best["value"] - latest["value"]) / best["value"]
+        if drop > threshold:
+            out.append({
+                "segment": segment, "engine": engine, "unit": unit,
+                "latest": latest["value"], "latest_source":
+                    latest["source"], "best": best["value"],
+                "best_source": best["source"],
+                "drop_pct": round(100 * drop, 1),
+                "threshold_pct": round(100 * threshold, 1),
+            })
+    return out

@@ -19,6 +19,16 @@ Schema (one JSON object per line; `schema` bumps on breaking change):
                   per-chip claim)
     groups_per_device
                   G / mesh size (ceil), same null rule
+    predicted_rounds_per_sec, attainment_pct, bound
+                  roofline stamp (DESIGN.md §12): the HBM/FLOP-bound
+                  ceiling the segment's engine was predicted to hit,
+                  how much of it the measured rate attained, and which
+                  resource binds ("hbm"/"flops"); null = unstamped
+                  (pre-r12 records — obs.history.backfill_record adds
+                  the keys as null on read, proven by the auditor's
+                  manifest pass)
+    trace_path    the Chrome trace-event file a --trace-dir run wrote
+                  for this segment's process, same null rule
     ...           caller fields: engine, warmup_wall_s / timed_wall_s
                   (the compile-vs-run split), rates, state_identical /
                   metrics_identical / flight_identical verdicts,
@@ -39,6 +49,13 @@ import time
 
 MANIFEST_ENV = "RAFT_TPU_MANIFEST"
 DEFAULT_PATH = "bench_manifest.jsonl"
+
+# r12 observability keys: in EVERY record from emission (null until the
+# caller fills them), and backfilled as null onto pre-r12 records by
+# obs.history.backfill_record — one list, imported by both sides and by
+# the analysis auditor's manifest pass so the two rules cannot drift.
+ROOFLINE_KEYS = ("predicted_rounds_per_sec", "attainment_pct", "bound",
+                 "trace_path")
 
 
 def config_hash(cfg) -> str:
@@ -76,8 +93,10 @@ def emit_manifest(segment: str, cfg, device: str | None = None,
            "jax": jv, "jaxlib": jlv, "device": device,
            # Mesh provenance keys exist in EVERY record (null until the
            # caller fills them) so a reader can always distinguish "ran
-           # on one chip" from "device count unrecorded".
-           "mesh_shape": None, "groups_per_device": None}
+           # on one chip" from "device count unrecorded". The r12
+           # roofline/trace keys follow the same rule.
+           "mesh_shape": None, "groups_per_device": None,
+           **{k: None for k in ROOFLINE_KEYS}}
     rec.update(fields)
     path = path or os.environ.get(MANIFEST_ENV) or DEFAULT_PATH
     if path != "-":

@@ -1,0 +1,289 @@
+"""Roofline attainment: the HBM/FLOP-bound rounds/s ceiling every bench
+number is measured against (DESIGN.md §12).
+
+DESIGN.md §7 established the tick is an IO problem (17.8 GB accessed vs
+13.2 GFLOP at 100K groups), echoing the hardware-consensus literature's
+claim that consensus is data movement, not arithmetic (PAPERS.md,
+arXiv:1605.05619). This module turns that observation into a per-
+segment instrument: for each (cfg, G, engine) it derives
+
+- **bytes moved per tick** from the PR-11 auditor's reconciled byte
+  model (`analysis.bytemodel.derived_wire_model`) — the ONE byte
+  accounting in the repo; no second hand-pinned copy here. The XLA
+  scan must carry the resident per-group state (native dtypes) through
+  HBM every tick; the fused-chunk kernel moves the full wire form
+  (i32 lanes, histograms + flight rings included) once per CHUNK-tick
+  launch. Both are *floors*: the minimum traffic the engine's
+  residency scheme permits, so the predicted ceiling is an upper bound
+  and attainment (measured/predicted) is an honest efficiency figure.
+- **FLOPs per tick** from `jax.jit(tick).lower(...).compile()
+  .cost_analysis()` at a small probe shape, scaled linearly in G (the
+  tick is elementwise over groups; per-group cost is G-independent).
+  The same compile also reports XLA's *actual* scheduled traffic
+  (``bytes accessed``) — recorded next to the floor so the
+  materialized-intermediates blowup (~22x at the headline shape) is a
+  published number, not DESIGN.md lore.
+
+The ceiling: predicted ticks/s = 1 / max(bytes/tick / HBM peak,
+FLOPs/tick / VPU peak), `bound` names the binding resource, and
+predicted rounds/s = predicted ticks/s x steady-state commits/tick
+(G x (cmds_per_tick + client_rate); 0 for the election-only config-2
+shape, whose workload commits nothing by construction — attainment is
+still defined there via ticks/s).
+
+Peaks default to the TPU v5 lite the bench history was measured on and
+follow env overrides on other parts: $RAFT_TPU_HBM_GBPS (819 GB/s
+default, the figure DESIGN.md §7 used) and $RAFT_TPU_VPU_GFLOPS
+(14,300 — back-derived from §7's "13.2 GFLOP is ~6% of the VPU budget
+at 65 ticks/s" calibration). On a CPU box the prediction side still
+runs (eval_shape + one tiny probe compile, no accelerator needed) with
+``measured_ticks_per_sec=None`` — the model is testable everywhere,
+and the bench stamps attainment only for real TPU walls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+# v5e HBM peak the DESIGN.md §7 arithmetic used.
+DEFAULT_HBM_GBPS = 819.0
+# v5e VPU peak back-derived from §7 ("13.2 GFLOP ~ 6% of the VPU
+# budget at the measured 65 ticks/s" => ~14.3 TFLOP/s). An estimate —
+# the hbm/flops classification is insensitive to 2x error here because
+# the two candidate times differ by orders of magnitude on both
+# engines; override with $RAFT_TPU_VPU_GFLOPS for other parts.
+DEFAULT_VPU_GFLOPS = 14_300.0
+
+HBM_ENV = "RAFT_TPU_HBM_GBPS"
+VPU_ENV = "RAFT_TPU_VPU_GFLOPS"
+
+# Ticks per kernel launch assumed when the caller does not say —
+# bench.py's CHUNK (its chunk loops pass the real value through).
+DEFAULT_CHUNK_TICKS = 200
+
+# Probe group count for the FLOPs compile: one kernel block. Small
+# enough that the probe compile is cheap on any box, large enough that
+# per-group costs dominate the fixed overhead the linear scaling
+# ignores.
+FLOPS_PROBE_GROUPS = 1024
+
+# The manifest/segment stamp every published number must carry
+# (ISSUE r12 acceptance; obs.manifest defaults them to null).
+ROOFLINE_FIELDS = ("predicted_rounds_per_sec", "attainment_pct", "bound")
+
+
+def peak_hbm_gbps() -> float:
+    return float(os.environ.get(HBM_ENV, DEFAULT_HBM_GBPS))
+
+
+def peak_vpu_gflops() -> float:
+    return float(os.environ.get(VPU_ENV, DEFAULT_VPU_GFLOPS))
+
+
+def engine_class(engine: str | None) -> str:
+    """"pallas" for any fused-chunk kernel engine string (sharded or
+    not), else "xla" — the residency scheme, which is what the byte
+    model depends on. Prefix match, NOT substring: a fallback string
+    like "xla-scan (pallas mismatch!)" names the engine that STOOD
+    (the XLA scan), and pricing it with the kernel's byte model would
+    overstate its ceiling ~200-fold."""
+    return "pallas" if engine and engine.startswith("pallas") else "xla"
+
+
+# ------------------------------------------------------------ byte model
+
+
+def _derived_model(cfg, with_flight: bool) -> dict:
+    from raft_tpu.analysis import bytemodel
+    model = bytemodel.derived_wire_model(cfg, with_flight=with_flight)
+    if model["problems"]:
+        # Refuse to predict off a drifted layout — same contract as
+        # analysis.startup_audit, reachable even when a caller skipped
+        # the audit.
+        raise RuntimeError(
+            "roofline: byte model reconciliation failed:\n  "
+            + "\n  ".join(model["problems"]))
+    return model
+
+
+def tick_byte_model(cfg, n_groups: int, engine: str | None,
+                    nd: int = 1, chunk_ticks: int | None = None,
+                    with_flight: bool = True) -> dict:
+    """Minimum HBM bytes one tick moves PER CHIP under `engine`'s
+    residency scheme, derived from the reconciled byte model.
+
+    - xla: read + write the resident per-group bytes every tick —
+      native-dtype State leaves, the per-group metric lanes, and the
+      flight ring (the global [H] histograms are G-independent and
+      excluded).
+    - pallas: the full i32 wire form (histogram rows + flight rings
+      included) crosses HBM once per `chunk_ticks`-tick launch, in and
+      out, at the per-device padded group count.
+    """
+    from raft_tpu.sim import pkernel
+
+    cls = engine_class(engine)
+    model = _derived_model(cfg, with_flight)
+    wire = model["wire_bytes_derived"]
+    resident = sum(r["native_bytes"] for r in model["leaves"]
+                   if r["kind"] in ("state", "metric-lane")
+                   or (with_flight and r["kind"] == "flight-ring"))
+    if cls == "pallas":
+        chunk = chunk_ticks or DEFAULT_CHUNK_TICKS
+        padded_per_dev = -(-n_groups // (nd * pkernel.GB)) * pkernel.GB
+        per_tick = 2 * wire * padded_per_dev / chunk
+    else:
+        chunk = None
+        per_tick = 2 * resident * (-(-n_groups // nd))
+    return {"engine_class": cls, "wire_bytes_per_group": wire,
+            "resident_bytes_per_group": resident,
+            "bytes_per_tick_per_chip": per_tick,
+            "chunk_ticks": chunk}
+
+
+# ----------------------------------------------------------- FLOPs probe
+
+_FLOPS_CACHE: dict = {}
+
+
+def _flops_key(cfg, g: int) -> str:
+    d = dataclasses.asdict(cfg)
+    d.pop("seed", None)   # seed changes constants, never the program
+    return json.dumps(d, sort_keys=True) + f"@{g}"
+
+
+def tick_cost_analysis(cfg, probe_groups: int = FLOPS_PROBE_GROUPS) -> (
+        dict | None):
+    """`cost_analysis()` of ONE compiled XLA tick at the probe shape:
+    {"flops": ..., "bytes_accessed": ...} per tick at `probe_groups`
+    groups, or None when the backend cannot report it. Memoized per
+    (cfg-minus-seed, probe shape) — the fault knobs change the traced
+    program, the seed does not. Abstract lowering (eval_shape inputs),
+    so no device buffers move; the compile itself is the only cost."""
+    key = _flops_key(cfg, probe_groups)
+    if key in _FLOPS_CACHE:
+        return _FLOPS_CACHE[key]
+    out = None
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from raft_tpu import sim
+        from raft_tpu.sim.step import tick as _tick
+
+        st = jax.eval_shape(lambda: sim.init(cfg, n_groups=probe_groups))
+        lowered = jax.jit(lambda s, t: _tick(cfg, s, t)).lower(
+            st, jax.ShapeDtypeStruct((), jnp.int32))
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca and ca.get("flops") is not None:
+            out = {"flops": float(ca["flops"]),
+                   "bytes_accessed": (float(ca["bytes accessed"])
+                                      if ca.get("bytes accessed")
+                                      is not None else None)}
+    except Exception:   # no backend / cost model: prediction degrades
+        out = None      # to hbm-only, it must never fail a bench
+    _FLOPS_CACHE[key] = out
+    return out
+
+
+def tick_flops(cfg, n_groups: int,
+               probe_groups: int = FLOPS_PROBE_GROUPS) -> dict | None:
+    """FLOPs (and XLA's scheduled bytes) per tick at `n_groups`,
+    linearly scaled from the probe shape."""
+    probe = min(probe_groups, n_groups)
+    ca = tick_cost_analysis(cfg, probe_groups=probe)
+    if ca is None:
+        return None
+    scale = n_groups / probe
+    return {"flops_per_tick": ca["flops"] * scale,
+            "xla_bytes_accessed_per_tick":
+                (ca["bytes_accessed"] * scale
+                 if ca["bytes_accessed"] is not None else None),
+            "flops_probe_groups": probe}
+
+
+# -------------------------------------------------------------- roofline
+
+
+def roofline(cfg, n_groups: int, engine: str | None, nd: int = 1,
+             chunk_ticks: int | None = None, with_flight: bool = True,
+             measured_ticks_per_sec: float | None = None,
+             flops: bool = True) -> dict:
+    """The full roofline record for one (cfg, G, engine) point.
+
+    `measured_ticks_per_sec=None` (a CPU box, or an unsupported-engine
+    segment) leaves ``attainment_pct`` null — prediction always runs.
+    `flops=False` skips the probe compile (hbm-only bound) for callers
+    that cannot afford any compile at all."""
+    bm = tick_byte_model(cfg, n_groups, engine, nd=nd,
+                         chunk_ticks=chunk_ticks, with_flight=with_flight)
+    fm = tick_flops(cfg, n_groups) if flops else None
+    hbm_gbps, vpu_gflops = peak_hbm_gbps(), peak_vpu_gflops()
+    hbm_s = bm["bytes_per_tick_per_chip"] / (hbm_gbps * 1e9)
+    flops_per_chip = (fm["flops_per_tick"] / nd) if fm else None
+    vpu_s = (flops_per_chip / (vpu_gflops * 1e9)
+             if flops_per_chip is not None else 0.0)
+    bound = "hbm" if hbm_s >= vpu_s else "flops"
+    predicted_tps = 1.0 / max(hbm_s, vpu_s)
+    # Steady-state committed entries per tick: the scheduled fire-hose
+    # appends cmds_per_tick per group; with clients on, each of the
+    # client_slots open-loop sessions submits w.p. client_rate per tick
+    # (config.py §10 knobs), and every accepted op commits exactly once.
+    rounds_per_tick = n_groups * (cfg.cmds_per_tick
+                                  + cfg.client_slots * cfg.client_rate)
+    attainment = (None if measured_ticks_per_sec is None
+                  else 100.0 * measured_ticks_per_sec / predicted_tps)
+    return {
+        **bm,
+        "n_groups": n_groups, "nd": nd,
+        "flops_per_tick": fm["flops_per_tick"] if fm else None,
+        "xla_bytes_accessed_per_tick":
+            fm["xla_bytes_accessed_per_tick"] if fm else None,
+        "peak_hbm_gbps": hbm_gbps, "peak_vpu_gflops": vpu_gflops,
+        "hbm_s_per_tick": hbm_s,
+        "vpu_s_per_tick": vpu_s if fm else None,
+        "bound": bound,
+        "predicted_ticks_per_sec": predicted_tps,
+        "rounds_per_tick": rounds_per_tick,
+        "predicted_rounds_per_sec": predicted_tps * rounds_per_tick,
+        "measured_ticks_per_sec": measured_ticks_per_sec,
+        "attainment_pct": attainment,
+    }
+
+
+def segment_fields(cfg, n_groups: int, engine: str | None,
+                   ticks: int | None = None,
+                   timed_wall_s: float | None = None, nd: int = 1,
+                   chunk_ticks: int | None = None,
+                   with_flight: bool = True,
+                   measured: bool = True, flops: bool = True) -> dict:
+    """The dict every bench segment (and its manifest record) is
+    stamped with: the three contract fields (`ROOFLINE_FIELDS`) plus
+    the full derivation under ``"roofline"``. `measured=False` (CPU
+    box) nulls the measured side while the prediction still stands;
+    `flops=False` skips the probe compile (slow-compile boxes)."""
+    mtps = None
+    if measured and ticks and timed_wall_s:
+        mtps = ticks / timed_wall_s
+    try:
+        r = roofline(cfg, n_groups, engine, nd=nd, chunk_ticks=chunk_ticks,
+                     with_flight=with_flight, measured_ticks_per_sec=mtps,
+                     flops=flops)
+    except RuntimeError:
+        # A drifted byte model already failed the startup audit for
+        # drivers that gate on it; a caller that didn't still gets the
+        # contract keys, null.
+        return {"predicted_rounds_per_sec": None, "attainment_pct": None,
+                "bound": None, "roofline": None}
+    return {
+        "predicted_rounds_per_sec": round(r["predicted_rounds_per_sec"], 1),
+        "attainment_pct": (round(r["attainment_pct"], 2)
+                           if r["attainment_pct"] is not None else None),
+        "bound": r["bound"],
+        "roofline": {k: (round(v, 6) if isinstance(v, float) else v)
+                     for k, v in r.items()},
+    }

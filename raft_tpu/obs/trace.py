@@ -1,0 +1,327 @@
+"""Timeline tracing + soak heartbeat: span-based wall-clock traces in
+Chrome trace-event JSON (loadable in Perfetto / chrome://tracing), plus
+a periodic JSONL health snapshot for long soaks (DESIGN.md §12).
+
+Two complementary instruments:
+
+- **Tracer** — host-side spans (context manager or decorator) emitted
+  as Chrome trace-event ``"ph": "X"`` complete events. bench.py wraps
+  every segment's compile/warmup/timed regions and every timed chunk
+  in spans, and `pkernel.prun` / `kmesh.prun_sharded` mark their
+  launch boundaries, so a `--trace-dir` bench run yields one
+  ``trace_<label>.json`` per run showing exactly where the wall went.
+  Device-side detail is the profiler's job: pass ``--jax-profile`` to
+  bench.py and each segment is additionally wrapped in
+  ``jax.profiler.trace`` (TensorBoard/Perfetto-loadable, opt-in
+  because captures are large).
+- **Heartbeat** — during a long chunked run (the 60M-node-tick soak),
+  a JSONL line every N chunks with the counters and flight-ring-derived
+  health signals (election storms, leaderless stalls, the safety bit),
+  so a soak is observable mid-flight instead of only post-mortem.
+
+The module-level tracer slot (`set_tracer` / `span`) exists so deep
+callees (pkernel.prun, kmesh.prun_sharded, bench chunk loops) can emit
+spans without threading a tracer through every signature; with no
+tracer installed every hook is a no-op costing one attribute read.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import sys
+import threading
+import time
+
+# ---------------------------------------------------------------- tracer
+
+# Span categories, fixed so trace consumers (and the schema validator)
+# can rely on them: segment-level phases vs per-chunk launches.
+CAT_PHASE = "phase"      # compile / warmup / timed regions of a segment
+CAT_CHUNK = "chunk"      # one device launch inside a timed/warmup loop
+CAT_SEGMENT = "segment"  # a whole bench segment
+
+
+class Tracer:
+    """Collects Chrome trace-event complete spans ("ph": "X", ts/dur in
+    microseconds since the tracer's epoch). Thread-safe appends; one
+    process = one pid lane, host threads = tid lanes."""
+
+    def __init__(self):
+        self._epoch = time.perf_counter()
+        self._lock = threading.Lock()
+        self.events: list[dict] = []
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._epoch) * 1e6
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = CAT_PHASE, **args):
+        """Context manager recording one complete event around the
+        body. `args` land in the event's ``args`` dict (Perfetto shows
+        them in the selection panel)."""
+        t0 = self._now_us()
+        try:
+            yield self
+        finally:
+            ev = {"name": name, "cat": cat, "ph": "X", "ts": t0,
+                  "dur": self._now_us() - t0, "pid": os.getpid(),
+                  "tid": threading.get_ident() & 0x7FFFFFFF}
+            if args:
+                ev["args"] = args
+            with self._lock:
+                self.events.append(ev)
+
+    def traced(self, name: str | None = None, cat: str = CAT_PHASE):
+        """Decorator form of `span`."""
+        def deco(fn):
+            @functools.wraps(fn)
+            def wrapper(*a, **kw):
+                with self.span(name or fn.__qualname__, cat=cat):
+                    return fn(*a, **kw)
+            return wrapper
+        return deco
+
+    def instant(self, name: str, cat: str = CAT_PHASE, **args):
+        """One instant event ("ph": "i") — markers like 'gate failed'."""
+        ev = {"name": name, "cat": cat, "ph": "i", "s": "p",
+              "ts": self._now_us(), "pid": os.getpid(),
+              "tid": threading.get_ident() & 0x7FFFFFFF}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self.events.append(ev)
+
+    def to_json(self) -> dict:
+        """The Chrome trace-event container object."""
+        with self._lock:
+            return {"traceEvents": list(self.events),
+                    "displayTimeUnit": "ms"}
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as fh:
+            json.dump(self.to_json(), fh)
+        return path
+
+
+def validate_trace(obj) -> list[str]:
+    """Schema problems of a Chrome trace-event container (empty list ==
+    valid). The subset both chrome://tracing and Perfetto require:
+    a ``traceEvents`` list whose events carry name/ph/ts/pid/tid, with
+    a numeric ``dur`` on every complete ("X") event. Tests and any
+    manifest-attaching caller share this one validator."""
+    problems = []
+    if not isinstance(obj, dict) or not isinstance(
+            obj.get("traceEvents"), list):
+        return ["trace container is not {'traceEvents': [...]}"]
+    for i, ev in enumerate(obj["traceEvents"]):
+        if not isinstance(ev, dict):
+            problems.append(f"event #{i} is not an object")
+            continue
+        for k in ("name", "ph", "ts", "pid", "tid"):
+            if k not in ev:
+                problems.append(f"event #{i} ({ev.get('name')!r}) missing "
+                                f"required key {k!r}")
+        if ev.get("ph") == "X" and not isinstance(
+                ev.get("dur"), (int, float)):
+            problems.append(f"event #{i} ({ev.get('name')!r}) is a "
+                            f"complete span without a numeric 'dur'")
+        for k in ("ts", "dur"):
+            if k in ev and not isinstance(ev[k], (int, float)):
+                problems.append(f"event #{i}: {k} is not numeric")
+    return problems
+
+
+# Module-level tracer slot: None = tracing off, every hook a no-op.
+_TRACER: Tracer | None = None
+
+
+def set_tracer(tracer: Tracer | None) -> Tracer | None:
+    """Install (or clear, with None) the process tracer; returns the
+    previous one so tests can restore it."""
+    global _TRACER
+    prev, _TRACER = _TRACER, tracer
+    return prev
+
+
+def get_tracer() -> Tracer | None:
+    return _TRACER
+
+
+def span(name: str, cat: str = CAT_PHASE, **args):
+    """`tracer.span(...)` against the installed tracer, or a null
+    context when tracing is off — the form deep callees use."""
+    t = _TRACER
+    if t is None:
+        return contextlib.nullcontext()
+    return t.span(name, cat=cat, **args)
+
+
+def chunk_span(engine: str, t0: int, n_ticks: int, **args):
+    """The per-chunk span BOTH engines' chunk loops emit — one shared
+    producer so the XLA and kernel lanes of a trace are named
+    identically (``chunk xla [t0,t0+n)`` / ``chunk pallas [...)``) and
+    a trace consumer can diff the two engines' chunk cadence."""
+    return span(f"chunk {engine} [{t0},{t0 + n_ticks})", cat=CAT_CHUNK,
+                engine=engine, t0=int(t0), n_ticks=int(n_ticks), **args)
+
+
+# ------------------------------------------------------------- heartbeat
+
+
+class Heartbeat:
+    """Periodic JSONL health snapshot for long chunked runs.
+
+    Call `beat(label, tick_at, metrics, flight)` after every chunk; one
+    record is appended every `every` chunks (and always on the first
+    beat of a label, so even a run killed in its first minutes leaves a
+    record). Health signals are derived from the same surfaces the gate
+    machinery uses — GlobalMetrics-style counters from `Metrics`, storm
+    /stall detection from the flight-recorder ring:
+
+    - ``election_storm``: more completed elections in the last RING
+      ticks than half the fleet — the fleet is thrashing leaders, not
+      replicating (the config-2 crash-churn shape trips this by
+      design; a throughput segment must not).
+    - ``leaderless_stall``: some group's CURRENT leaderless streak
+      exceeds the flight ring — it has been electing for > RING ticks,
+      longer than the recorder can even see.
+    - ``safety_ok``: the per-tick safety fold has not latched a
+      violation anywhere.
+    """
+
+    def __init__(self, path: str, every: int = 10):
+        if every < 1:
+            raise ValueError(f"heartbeat every={every} must be >= 1")
+        self.path = path
+        self.every = every
+        self._beats: dict[str, int] = {}
+
+    def _due(self, label: str) -> bool:
+        """Cadence: true on the first beat of a label and every
+        `every`-th thereafter."""
+        n = self._beats.get(label, 0)
+        self._beats[label] = n + 1
+        return n % self.every == 0
+
+    def beat(self, label: str, tick_at: int, metrics, flight=None) -> (
+            dict | None):
+        """Maybe-append one record; returns it (or None when skipped —
+        not this label's Nth chunk)."""
+        if not self._due(label):
+            return None
+        import numpy as np
+
+        from raft_tpu.sim.run import total_rounds, unsafe_groups
+        leaderless = np.asarray(metrics.leaderless)
+        rec = {
+            "label": label,
+            "unix_time": round(time.time(), 3),
+            "tick": int(tick_at),
+            "rounds_total": total_rounds(metrics),
+            "elections_total": int(metrics.elections),
+            "unsafe_groups": unsafe_groups(metrics),
+            "safety_ok": unsafe_groups(metrics) == 0,
+            "leaderless_groups": int((leaderless > 0).sum()),
+            "max_leaderless_streak": int(leaderless.max(initial=0)),
+        }
+        if metrics.client_acked is not None:
+            from raft_tpu.sim.run import (total_client_ops,
+                                          total_client_retries)
+            rec["client_acked_total"] = total_client_ops(metrics)
+            rec["client_retries_total"] = total_client_retries(metrics)
+        if flight is not None:
+            from raft_tpu.obs.recorder import RING, flight_rows
+            rows = flight_rows(flight)
+            ring_elections = sum(r["elections"] for r in rows)
+            n_groups = int(leaderless.shape[0])
+            rec.update(
+                ring_ticks=len(rows),
+                ring_elections=ring_elections,
+                ring_msgs=sum(r["msgs"] for r in rows),
+                election_storm=ring_elections > n_groups // 2,
+                leaderless_stall=rec["max_leaderless_streak"] > RING,
+            )
+        with open(self.path, "a") as fh:
+            fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        return rec
+
+    def beat_wire(self, label: str, tick_at: int, cfg, leaves,
+                  g: int) -> dict | None:
+        """The kernel-engine beat: health straight off the wire tuple
+        between chunk launches — the long soak DESIGN.md §12b promises
+        to make observable mid-flight runs on the PROMOTED (kernel)
+        engine, so a heartbeat that only rode the XLA loops would go
+        silent during exactly that window. Reads the metric lanes via
+        the pkernel counter helpers (a few [GS, 128] lanes to host,
+        cheap next to a chunk); flight-ring-derived keys are omitted —
+        unfolding six [RING, GS, 128] rings per beat is not (kflight
+        is the gate/dump path). NOTE: the readback forces the
+        dispatched chunk to complete, so timed walls measured with a
+        heartbeat installed include that sync (same caveat as `beat`)."""
+        if not self._due(label):
+            return None
+        import numpy as np
+
+        from raft_tpu.sim import pkernel
+        lane = {n: np.asarray(pkernel._unfold_g(
+                    pkernel._mleaf(cfg, leaves, n)))[:g]
+                for n in ("leaderless", "safety")}
+        unsafe = int((lane["safety"] == 0).sum())
+        rec = {
+            "label": label, "engine": "pallas",
+            "unix_time": round(time.time(), 3),
+            "tick": int(tick_at),
+            "rounds_total": pkernel.kcommitted(cfg, leaves, g),
+            "elections_total": pkernel.kelections(cfg, leaves, g),
+            "unsafe_groups": unsafe, "safety_ok": unsafe == 0,
+            "leaderless_groups": int((lane["leaderless"] > 0).sum()),
+            "max_leaderless_streak": int(lane["leaderless"]
+                                         .max(initial=0)),
+        }
+        if cfg.clients_u32:
+            rec["client_acked_total"] = pkernel.kacked(cfg, leaves, g)
+            rec["client_retries_total"] = pkernel.kretries(cfg, leaves, g)
+        with open(self.path, "a") as fh:
+            fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        return rec
+
+
+# Module-level heartbeat slot, same pattern as the tracer.
+_HEARTBEAT: Heartbeat | None = None
+
+
+def set_heartbeat(hb: Heartbeat | None) -> Heartbeat | None:
+    global _HEARTBEAT
+    prev, _HEARTBEAT = _HEARTBEAT, hb
+    return prev
+
+
+def heartbeat(label: str, tick_at: int, metrics, flight=None):
+    """Module-level `Heartbeat.beat` against the installed heartbeat
+    (no-op when none) — what the XLA chunk loops call."""
+    hb = _HEARTBEAT
+    if hb is None:
+        return None
+    try:
+        return hb.beat(label, tick_at, metrics, flight)
+    except OSError as e:   # a full disk must not kill a 60M-tick soak
+        print(f"[heartbeat] write failed ({e}); continuing",
+              file=sys.stderr, flush=True)
+        return None
+
+
+def heartbeat_wire(label: str, tick_at: int, cfg, leaves, g: int):
+    """Module-level `Heartbeat.beat_wire` (no-op when none) — what the
+    kernel chunk loops call between launches."""
+    hb = _HEARTBEAT
+    if hb is None:
+        return None
+    try:
+        return hb.beat_wire(label, tick_at, cfg, leaves, g)
+    except OSError as e:
+        print(f"[heartbeat] write failed ({e}); continuing",
+              file=sys.stderr, flush=True)
+        return None

@@ -184,8 +184,13 @@ def prun_sharded(cfg: RaftConfig, st: State, n_ticks: int, mesh: Mesh,
             f"{pkernel.hbm_bytes(cfg, g, mesh.size, with_flight=wf)} B "
             f"> {pkernel.HBM_LIMIT_BYTES} B) — use the XLA path")
     leaves, g = kinit_sharded(cfg, st, mesh, metrics, flight)
-    leaves = kstep_sharded(cfg, leaves, t0, n_ticks, mesh,
-                           interpret=interpret)
+    # Same chunk-boundary span as pkernel.prun, on the sharded engine's
+    # lane (no-op without a tracer installed).
+    from raft_tpu.obs import trace as _trace
+    with _trace.chunk_span(f"pallas-sharded-{mesh.size}dev", int(t0),
+                           int(n_ticks), interpret=bool(interpret)):
+        leaves = kstep_sharded(cfg, leaves, t0, n_ticks, mesh,
+                               interpret=interpret)
     if flight is None:
         return pkernel.kfinish(cfg, leaves, g, metrics)
     st2, met = pkernel.kfinish(cfg, leaves, g, metrics)

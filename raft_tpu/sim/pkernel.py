@@ -1916,7 +1916,13 @@ def prun(cfg: RaftConfig, st: State, n_ticks: int, t0: int = 0,
             f"single-device HBM {hbm_bytes(cfg, g, with_flight=wf)} B > "
             f"{HBM_LIMIT_BYTES} B) — use the XLA path (run.run)")
     leaves, g = kinit(cfg, st, metrics, flight)
-    leaves = kstep(cfg, leaves, t0, n_ticks, interpret=interpret)
+    # Chunk-boundary span (obs.trace; no-op without a tracer): chunked
+    # prun drivers — dryrun, triage re-execution — leave one span per
+    # launch on the kernel lane of a --trace-dir timeline.
+    from raft_tpu.obs import trace as _trace
+    with _trace.chunk_span("pallas", int(t0), int(n_ticks),
+                           interpret=bool(interpret)):
+        leaves = kstep(cfg, leaves, t0, n_ticks, interpret=interpret)
     if flight is None:
         return kfinish(cfg, leaves, g, metrics)
     st2, met = kfinish(cfg, leaves, g, metrics)

@@ -23,11 +23,18 @@ def enable(cache_dir: str | None = None,
            min_compile_secs: float = 1.0) -> str:
     """Point jax's persistent compilation cache at the repo's shared
     directory (or `cache_dir`). Call AFTER `import jax` and any
-    platform pinning; returns the directory used."""
+    platform pinning; returns the directory used.
+
+    Also exports $JAX_COMPILATION_CACHE_DIR so every CHILD process
+    inherits the same cache — the test suite shells out (static-audit /
+    bench-history / sweep subprocess tests, the dryrun hop), and before
+    this export each of those children recompiled from scratch inside
+    the tier-1 budget while the parent's warm cache sat unused."""
     import jax
 
     cache_dir = cache_dir or DEFAULT_DIR
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_secs)
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
     return cache_dir

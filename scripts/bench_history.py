@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Bench-history trend table + regression gate (DESIGN.md §12).
+
+Parses every BENCH_r*.json + MULTICHIP_*.json under --root plus the
+bench manifest JSONL into one normalized trajectory (obs.history),
+prints the per-segment trend table, and — with --check — exits nonzero
+when any comparable series' latest point regressed more than
+--threshold below its best ancestor. Run on the checked-in snapshots
+this prints the r01->r05 trajectory and `--check --threshold 0.15`
+flags the r02->r04 XLA throughput fade (7.18M -> 5.07M rounds/s); the
+driver gets a real perf gate instead of an unread pile of JSON.
+
+No jax import, no device, no compile — pure file parsing, safe
+anywhere (including the tier-1 test tier, tests/test_perf_obs.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from raft_tpu.obs import history  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=".",
+                    help="directory holding BENCH_r*/MULTICHIP_* files")
+    ap.add_argument("--manifest", default=None,
+                    help="bench manifest JSONL path ('-' to skip; default "
+                         "$RAFT_TPU_MANIFEST or <root>/bench_manifest.jsonl)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 2 when any series regresses past the "
+                         "threshold vs its best ancestor")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="relative drop that counts as a regression "
+                         "(default 0.15)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the normalized rows + regressions as JSON "
+                         "instead of the table")
+    args = ap.parse_args(argv)
+
+    rows = history.load_history(args.root, manifest=args.manifest)
+    if not rows:
+        print(f"no bench history found under {args.root!r}",
+              file=sys.stderr)
+        return 1
+    regs = history.regressions(rows, threshold=args.threshold)
+    if args.json:
+        print(json.dumps({"rows": rows, "regressions": regs}, indent=1))
+    else:
+        print(history.trend_table(rows))
+        print(f"{len(rows)} points across "
+              f"{len(history.series(rows))} series")
+    if regs:
+        for r in regs:
+            print(f"REGRESSION: {r['segment']} [{r['engine']}] "
+                  f"{r['latest']:,.1f} {r['unit']} ({r['latest_source']}) "
+                  f"is -{r['drop_pct']}% vs best ancestor "
+                  f"{r['best']:,.1f} ({r['best_source']}); "
+                  f"threshold {r['threshold_pct']}%", file=sys.stderr)
+        if args.check:
+            return 2
+    elif args.check:
+        print(f"regression check clean at threshold {args.threshold}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,7 +28,10 @@ jax.config.update("jax_platforms", "cpu")
 # executables on disk so only the first-ever run of each (cfg, shape)
 # program pays it. The cache dir is gitignored and machine-local; the
 # recipe is shared with the dryrun and the multichip sweep so all
-# drivers warm the same entries.
+# drivers warm the same entries, and enable() exports
+# $JAX_COMPILATION_CACHE_DIR so subprocesses the tests spawn (script
+# smoke tests, the dryrun hop) hit the same cache instead of paying
+# the known test-#33 XLA-compile wall again per child.
 from raft_tpu.utils import compile_cache  # noqa: E402
 
 compile_cache.enable()

@@ -78,6 +78,39 @@ def test_triage_bisects_to_corrupted_tick_and_leaf():
                              chunk=16) is None
 
 
+def test_triage_bisects_client_leaf_corruption():
+    """The client-leaf flavor of the r07 corruption test (which only
+    covers nodes.term): a session dedup-table entry (`session_seq`)
+    flipped mid-run on a clients-on universe — triage must name the
+    exact tick AND the session leaf. The dedup table is the
+    exactly-once invariant's ground truth, so a triage that cannot
+    bisect INTO it would leave the worst class of divergence (silent
+    double-apply) unlocalized."""
+    cfg = RaftConfig(n_groups=8, k=3, seed=21, drop_prob=0.05,
+                     crash_prob=0.2, crash_epoch=16, log_cap=8,
+                     compact_every=4, sessions=True, cmds_per_tick=0,
+                     client_rate=0.3, client_slots=2)
+    corrupt_at, n_ticks = 21, 32
+
+    def clean(st, n, t):
+        return run(cfg, st, n, t)[0]
+
+    def corrupt(st, n, t0):
+        for t in range(t0, t0 + n):
+            st = run(cfg, st, 1, t)[0]
+            if t == corrupt_at:
+                st = st._replace(nodes=st.nodes._replace(
+                    session_seq=st.nodes.session_seq.at[3, 1, 0].add(7)))
+        return st
+
+    report = bisect_divergence(clean, corrupt, sim.init(cfg), n_ticks,
+                               chunk=16)
+    assert report is not None
+    assert report["tick"] == corrupt_at
+    assert report["boundary"] == (16, 32)
+    assert "session_seq" in report["leaf_report"]
+
+
 def test_triage_names_kernel_wire_leaf():
     """A flipped kernel wire leaf surfaces under its State field name
     after kfinish — the kernel-state flavor of leaf naming (no kernel

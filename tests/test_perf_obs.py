@@ -1,0 +1,396 @@
+"""Performance-observability tests (DESIGN.md §12): the roofline stamp
+is pinned at the published wire layouts (8,308 / 11,056 B/group), the
+bench-history tracker reads the checked-in BENCH_r* trajectory and
+flags the r02->r05 XLA fade at the 0.15 threshold, Chrome trace-event
+output schema-validates with distinct compile/warmup/timed + per-chunk
+spans, the soak heartbeat emits health records, the segment wall-key
+set is normalized through ONE producer, and the static-audit CLI +
+bench_history --check both run as fast tier-1 gates."""
+
+from __future__ import annotations
+
+import inspect
+import json
+import os
+import subprocess
+import sys
+
+import conftest  # noqa: F401  (pins the CPU platform before jax loads)
+from raft_tpu.config import RaftConfig
+from raft_tpu.obs import (ROOFLINE_KEYS, Heartbeat, Tracer, history,
+                          roofline, set_heartbeat, set_tracer,
+                          validate_trace)
+from raft_tpu.obs import trace as obs_trace
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)   # for `import bench`
+
+CFG = RaftConfig(n_groups=8, k=3, seed=21, drop_prob=0.05, crash_prob=0.2,
+                 crash_epoch=16, log_cap=8, compact_every=4)
+
+
+# ----------------------------------------------------------- roofline
+
+
+def test_roofline_pinned_at_published_wire_layouts():
+    """The prediction rides the PR-11 reconciled byte model — pinned
+    here at the two published layouts so a layout change that forgets
+    the roofline shows up as a failed pin, not a silently wrong
+    ceiling."""
+    from raft_tpu.analysis import bytemodel
+    for cfg, pinned in ((bytemodel.headline_cfg(), 8_308),
+                        (bytemodel.clients_cfg(), 11_056)):
+        r = roofline.roofline(cfg, 100_000, "pallas-fused-chunk",
+                              chunk_ticks=200, flops=False)
+        assert r["wire_bytes_per_group"] == pinned
+        assert r["predicted_ticks_per_sec"] > 0
+        assert r["bound"] == "hbm"   # no flops probe -> hbm side binds
+
+
+def test_engine_class_prefix_not_substring():
+    """A fallback engine string names the engine that STOOD — it must
+    not price under the kernel's byte model."""
+    for mod in (roofline, history):
+        assert mod.engine_class("pallas-fused-chunk") == "pallas"
+        assert mod.engine_class("pallas-fused-chunk-sharded-8dev") \
+            == "pallas"
+        assert mod.engine_class("xla-scan (pallas mismatch!)") == "xla"
+        assert mod.engine_class("xla-scan (pallas error: XlaRuntimeError)"
+                                ) == "xla"
+        assert mod.engine_class(None) == "xla"
+
+
+def test_roofline_attainment_and_bound():
+    cfg = RaftConfig(seed=42)
+    r = roofline.roofline(cfg, 100_000, "xla-scan",
+                          measured_ticks_per_sec=78.0, flops=False)
+    # XLA must move at least the resident native state both ways.
+    assert r["bytes_per_tick_per_chip"] == \
+        2 * r["resident_bytes_per_group"] * 100_000
+    assert abs(r["attainment_pct"]
+               - 100.0 * 78.0 / r["predicted_ticks_per_sec"]) < 1e-9
+    # The kernel moves the wire once per chunk: per-tick traffic is
+    # chunk_ticks-fold smaller, so its hbm-side ceiling must dwarf the
+    # XLA path's.
+    rk = roofline.roofline(cfg, 100_000, "pallas-fused-chunk",
+                           chunk_ticks=200, flops=False)
+    assert rk["predicted_ticks_per_sec"] > 50 * r["predicted_ticks_per_sec"]
+    # rounds/tick basis: headline commits cmds_per_tick per group.
+    assert r["rounds_per_tick"] == 100_000 * cfg.cmds_per_tick
+
+
+def test_roofline_prediction_runs_without_measurement():
+    """The CPU-box contract: prediction always runs; attainment is
+    null; the three stamp fields are present."""
+    f = roofline.segment_fields(RaftConfig(seed=42), 1_000, "xla-scan",
+                                ticks=200, timed_wall_s=1.0,
+                                measured=False, flops=False)
+    assert set(roofline.ROOFLINE_FIELDS) <= set(f)
+    assert f["attainment_pct"] is None
+    assert f["bound"] == "hbm"
+    assert f["predicted_rounds_per_sec"] > 0
+    assert f["roofline"]["measured_ticks_per_sec"] is None
+
+
+def test_roofline_peak_env_override(monkeypatch):
+    cfg = RaftConfig(seed=42)
+    base = roofline.roofline(cfg, 10_000, "xla-scan", flops=False)
+    monkeypatch.setenv(roofline.HBM_ENV,
+                       str(2 * roofline.DEFAULT_HBM_GBPS))
+    fast = roofline.roofline(cfg, 10_000, "xla-scan", flops=False)
+    assert abs(fast["predicted_ticks_per_sec"]
+               - 2 * base["predicted_ticks_per_sec"]) < 1e-6
+
+
+# ------------------------------------------------------- bench history
+
+
+def test_history_parses_checked_in_trajectory():
+    rows = history.load_history(ROOT, manifest="-")
+    s = history.series(rows)
+    xla = s[("throughput", "xla", "rounds/s")]
+    vals = [r["value"] for r in xla]
+    # r02 7.18M (parsed), r03 5.71M, r04 5.07M — the fade, in order.
+    assert 7182986.4 in vals and 5706722.7 in vals and 5065337.2 in vals
+    assert vals.index(7182986.4) < vals.index(5065337.2)
+    # The r05 kernel headline lands in its own series.
+    pal = s[("throughput", "pallas", "rounds/s")]
+    assert any(abs(r["value"] - 29271972.8) < 1 for r in pal)
+    table = history.trend_table(rows)
+    assert "throughput [xla]" in table and "-29.5% best" in table
+
+
+def test_history_flags_the_xla_fade_at_015():
+    rows = history.load_history(ROOT, manifest="-")
+    regs = history.regressions(rows, threshold=0.15)
+    hit = [r for r in regs if r["segment"] == "throughput"
+           and r["engine"] == "xla"]
+    assert len(hit) == 1
+    assert hit[0]["drop_pct"] >= 15
+    assert hit[0]["best_source"] == "BENCH_r02.json"
+    assert hit[0]["latest_source"] == "BENCH_r04.json"
+    # And the fade is under 50%, so a loose gate stays quiet.
+    assert not [r for r in history.regressions(rows, threshold=0.50)
+                if r["segment"] == "throughput"]
+
+
+def test_history_manifest_backfill_round_trip():
+    old = {"schema": 1, "segment": "throughput", "engine": "xla-scan",
+           "rounds_per_sec": 5.0}
+    back = history.backfill_record(old)
+    for k in history.R12_MANIFEST_KEYS:
+        assert back[k] is None
+    assert back["rounds_per_sec"] == 5.0
+    # A stamped record keeps its values through backfill.
+    stamped = dict(old, bound="hbm", attainment_pct=10.0)
+    assert history.backfill_record(stamped)["bound"] == "hbm"
+
+
+def test_bench_history_script_table_and_check(tmp_path):
+    """The acceptance run: the script on the checked-in JSONs prints
+    the full trajectory and exits 0; --check --threshold 0.15 exits
+    nonzero flagging the XLA throughput regression."""
+    script = os.path.join(ROOT, "scripts", "bench_history.py")
+    r = subprocess.run([sys.executable, script, "--root", ROOT,
+                        "--manifest", "-"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "throughput [xla]" in r.stdout
+    assert "7,182,986" in r.stdout and "5,065,337" in r.stdout
+    r2 = subprocess.run([sys.executable, script, "--root", ROOT,
+                         "--manifest", "-", "--check",
+                         "--threshold", "0.15"],
+                        capture_output=True, text=True, timeout=120)
+    assert r2.returncode == 2
+    assert "REGRESSION: throughput [xla]" in r2.stderr
+
+
+def test_audit_cli_static_level():
+    """`raft-tpu-audit --level static` (via its script body) as a fast
+    tier-1 gate next to the history check — the manifest-coverage pass
+    now rides contract_problems, so this also proves the r12 keys."""
+    script = os.path.join(ROOT, "scripts", "static_audit.py")
+    r = subprocess.run([sys.executable, script, "--level", "static"],
+                       capture_output=True, text=True, timeout=300,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_manifest_coverage_pass_names_drift():
+    """Synthetic drift: a manifest module whose records lack the
+    roofline keys, and a history module that forgets the backfill —
+    the auditor names both."""
+    from raft_tpu.analysis import contracts
+    from raft_tpu.obs import manifest as real_manifest
+    assert contracts.manifest_problems() == []
+
+    class _BadManifest:
+        ROOFLINE_KEYS = real_manifest.ROOFLINE_KEYS
+
+        @staticmethod
+        def emit_manifest(segment, cfg, path=None, **fields):
+            rec = real_manifest.emit_manifest(segment, cfg, path="-",
+                                              **fields)
+            for k in real_manifest.ROOFLINE_KEYS:
+                rec.pop(k, None)
+            rec.update(fields)
+            return rec
+
+    probs = contracts.manifest_problems(manifest_mod=_BadManifest)
+    assert any("predicted_rounds_per_sec" in p for p in probs)
+
+    class _BadHistory:
+        @staticmethod
+        def backfill_record(rec):
+            return dict(rec)   # forgot the keys
+
+    probs = contracts.manifest_problems(history_mod=_BadHistory)
+    assert any("backfill_record" in p for p in probs)
+
+
+# ------------------------------------------------------ trace + spans
+
+
+def test_tracer_chrome_schema(tmp_path):
+    t = Tracer()
+    with t.span("segment a", cat=obs_trace.CAT_SEGMENT):
+        with t.span("warmup+compile xla [a]"):
+            pass
+        with t.span("timed xla [a]"):
+            prev = set_tracer(t)
+            try:
+                # Both engines' chunk spans go through the ONE producer.
+                with obs_trace.chunk_span("xla", 0, 8, phase="timed"):
+                    pass
+                with obs_trace.chunk_span("pallas", 8, 8, phase="timed"):
+                    pass
+            finally:
+                set_tracer(prev)
+
+    @t.traced("decorated")
+    def f():
+        return 7
+
+    assert f() == 7
+    t.instant("marker", note="x")
+    path = t.save(str(tmp_path / "trace.json"))
+    with open(path) as fh:
+        obj = json.load(fh)
+    assert validate_trace(obj) == []
+    names = [e["name"] for e in obj["traceEvents"]]
+    assert "segment a" in names and "decorated" in names
+    assert "chunk xla [0,8)" in names and "chunk pallas [8,16)" in names
+    cats = {e["name"]: e["cat"] for e in obj["traceEvents"]}
+    assert cats["chunk xla [0,8)"] == obs_trace.CAT_CHUNK
+    assert cats["segment a"] == obs_trace.CAT_SEGMENT
+    # The validator actually rejects malformed events.
+    assert validate_trace({"traceEvents": [{"name": "x", "ph": "X",
+                                            "ts": 0.0, "pid": 1,
+                                            "tid": 1}]}) != []
+    assert validate_trace({"x": 1}) != []
+
+
+def test_bench_timed_chunks_emits_phase_and_chunk_spans(tmp_path):
+    """The XLA bench harness under a tracer: distinct warmup/timed
+    spans and one chunk span per device call, schema-valid — the
+    runtime half of the --trace-dir acceptance (the kernel half shares
+    the same chunk_span producer, pinned below)."""
+    import bench
+    from raft_tpu.sim.run import total_rounds
+    t = Tracer()
+    hb_path = tmp_path / "hb.jsonl"
+    prev = set_tracer(t)
+    prev_hb = set_heartbeat(Heartbeat(str(hb_path), every=1))
+    try:
+        bench._timed_chunks(CFG, 8, 16, lambda st, m: total_rounds(m),
+                            label="span-test", chunk=8)
+    finally:
+        set_tracer(prev)
+        set_heartbeat(prev_hb)
+    obj = t.to_json()
+    assert validate_trace(obj) == []
+    names = [e["name"] for e in obj["traceEvents"]]
+    assert "warmup+compile xla [span-test]" in names
+    assert "timed xla [span-test]" in names
+    chunks = [e for e in obj["traceEvents"]
+              if e["cat"] == obs_trace.CAT_CHUNK]
+    assert len(chunks) == 3          # 1 warmup + 2 timed
+    phases = {e["args"]["phase"] for e in chunks}
+    assert phases == {"warmup", "timed"}
+    # The heartbeat rode the timed loop.
+    recs = [json.loads(ln) for ln in hb_path.read_text().splitlines()]
+    assert recs and recs[0]["label"] == "span-test"
+    for k in ("tick", "rounds_total", "elections_total", "safety_ok",
+              "leaderless_groups", "ring_elections", "election_storm",
+              "leaderless_stall"):
+        assert k in recs[0]
+
+
+def test_kernel_paths_share_the_chunk_span_producer():
+    """Both kernel drivers (bench loops, prun, prun_sharded) emit their
+    per-chunk spans through obs.trace.chunk_span — pinned at source
+    level because a kernel launch needs a TPU (or a minutes-long
+    interpret compile) this tier cannot pay."""
+    import bench
+    from raft_tpu.parallel import kmesh
+    from raft_tpu.sim import pkernel
+    for fn in (bench._pallas_segment, bench._pallas_full_run,
+               pkernel.prun, kmesh.prun_sharded):
+        assert "chunk_span" in inspect.getsource(fn), fn.__name__
+
+
+def test_heartbeat_wire_beats_on_the_kernel_form(tmp_path):
+    """The kernel-engine heartbeat reads health straight off the wire
+    tuple (no kernel launch needed: kinit + the counter helpers are
+    host-side) — the promoted-engine soak stays observable."""
+    from raft_tpu import sim
+    from raft_tpu.sim import pkernel
+    leaves, g = pkernel.kinit(CFG, sim.init(CFG))
+    hb = Heartbeat(str(tmp_path / "hb.jsonl"), every=2)
+    rec = hb.beat_wire("pallas:smoke", 200, CFG, leaves, g)
+    assert rec is not None and rec["engine"] == "pallas"
+    assert rec["tick"] == 200 and rec["safety_ok"]
+    assert rec["rounds_total"] == pkernel.kcommitted(CFG, leaves, g)
+    assert hb.beat_wire("pallas:smoke", 400, CFG, leaves, g) is None
+    assert hb.beat_wire("pallas:smoke", 600, CFG, leaves, g) is not None
+    # And the bench kernel loops call it.
+    import bench
+    for fn in (bench._pallas_segment, bench._pallas_full_run):
+        assert "heartbeat_wire" in inspect.getsource(fn), fn.__name__
+
+
+def test_history_filters_incomparable_rows():
+    """CPU / smoke-shape manifest records and discarded-pallas tail
+    rates must not join the trajectory (they would always be a
+    series' latest point and wreck the gate)."""
+    import tempfile
+    recs = [
+        {"schema": 1, "segment": "throughput", "engine": "xla-scan",
+         "rounds_per_sec": 123.0, "device": "cpu:cpu",
+         "n_groups": 100_000},                       # CPU box
+        {"schema": 1, "segment": "throughput", "engine": "xla-scan",
+         "rounds_per_sec": 456.0, "device": "tpu:TPU v5 lite",
+         "n_groups": 1_000},                         # --quick shape
+        {"schema": 1, "segment": "throughput", "engine": "xla-scan",
+         "rounds_per_sec": 5_000_000.0, "device": "tpu:TPU v5 lite",
+         "n_groups": 100_000},                       # real point
+    ]
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as fh:
+        fh.write("\n".join(json.dumps(r) for r in recs))
+        path = fh.name
+    rows = history.parse_manifest_file(path)
+    os.unlink(path)
+    assert [r["value"] for r in rows] == [5_000_000.0]
+    # Tail "[pallas] ... -> N/s" lines are pre-differential and never
+    # harvested; the xla line still is.
+    doc = {"n": 9, "tail": "  [pallas] 100000 groups x 600 ticks: x in "
+           "2s -> 9,999,999 rounds/s\n  [xla] 100000 groups x 600 "
+           "ticks: x in 8s -> 7,000,000 rounds/s\n", "parsed": None}
+    with tempfile.NamedTemporaryFile("w", suffix="_r09.json",
+                                     delete=False) as fh:
+        json.dump(doc, fh)
+        path = fh.name
+    rows = history.parse_bench_file(path)
+    os.unlink(path)
+    assert [(r["engine"], r["value"]) for r in rows] \
+        == [("xla", 7_000_000.0)]
+
+
+def test_heartbeat_every_n_and_health_fields(tmp_path):
+    from raft_tpu.obs import flight_init, run_recorded
+    from raft_tpu import sim
+    st, m, f = run_recorded(CFG, sim.init(CFG), 40)
+    hb = Heartbeat(str(tmp_path / "hb.jsonl"), every=3)
+    emitted = [hb.beat("soak", 40 + i, m, f) for i in range(7)]
+    assert [e is not None for e in emitted] == [True, False, False,
+                                               True, False, False, True]
+    rec = emitted[0]
+    assert rec["safety_ok"] and rec["unsafe_groups"] == 0
+    assert rec["ring_ticks"] == 40   # 40 ticks < RING all recorded
+    assert isinstance(rec["election_storm"], bool)
+    assert isinstance(rec["leaderless_stall"], bool)
+
+
+# --------------------------------------------------- wall-key contract
+
+
+def test_wall_fields_one_producer_and_key_set():
+    import bench
+    full = bench._wall_fields(1.23456, xla_wall_s=2.0,
+                              xla_warmup_wall_s=3.0, kernel_wall_s=4.0,
+                              kernel_warmup_wall_s=5.0)
+    assert tuple(full) == bench.SEGMENT_WALL_KEYS
+    assert full["timed_wall_s"] == 1.235   # ms precision
+    sparse = bench._wall_fields(None, xla_wall_s=2.0)
+    assert tuple(sparse) == bench.SEGMENT_WALL_KEYS
+    assert sparse["kernel_wall_s"] is None
+    # Every segment builder routes through the one producer (the
+    # runtime path needs device walls this tier cannot pay; source
+    # pin keeps a new segment from hand-rolling its own keys).
+    for fn in (bench.bench_throughput, bench.bench_fault_latency,
+               bench.bench_election_rounds, bench.bench_reads,
+               bench.bench_clients):
+        src = inspect.getsource(fn)
+        assert "_wall_fields(" in src, fn.__name__
+        assert "_roofline_fields(" in src, fn.__name__
