@@ -74,6 +74,7 @@ from raft_tpu.config import RaftConfig
 # and the soak heartbeat.
 from raft_tpu.obs import (dump_flight, emit_manifest, flight_init,
                           run_recorded)
+from raft_tpu.obs.manifest import PACKING_KEYS
 from raft_tpu.obs import roofline as obs_roofline
 from raft_tpu.obs import trace as obs_trace
 from raft_tpu.sim.run import (latency_censored, latency_quantile,
@@ -177,6 +178,27 @@ def _wall_fields(timed_wall_s, xla_wall_s=None, xla_warmup_wall_s=None,
 # Filled by main() when --trace-dir is given: the Chrome trace file
 # this process will save, stamped into every segment/manifest record.
 _TRACE_PATH: str | None = None
+
+# Kernel wire-layout dials applied to every segment config — filled by
+# main() from --pack-wire (DESIGN.md §13). The promotion differentials
+# are unchanged: a packed kernel must still be bit-identical to the
+# XLA reference on full State + Metrics + flight ring, so --pack-wire
+# is a measured-delta run, not a weaker gate.
+_WIRE_DIALS: dict = {}
+
+
+def _seg_cfg(**kwargs) -> RaftConfig:
+    """A segment's RaftConfig with the run-wide wire-layout dials
+    applied — the ONE place --pack-wire reaches the configs, so no
+    segment can miss the dials (or double-apply them)."""
+    return RaftConfig(**kwargs, **_WIRE_DIALS)
+
+
+def _packing_fields(cfg) -> dict:
+    """The r13 manifest stamp: which wire-layout dials this segment's
+    kernel engine ran with (obs.manifest.PACKING_KEYS, null-by-default
+    in every record until stamped here)."""
+    return {k: getattr(cfg, k) for k in PACKING_KEYS}
 
 
 def _roofline_fields(cfg, n_groups: int, engine: str, ticks: int,
@@ -500,7 +522,7 @@ def bench_throughput(n_groups: int, ticks: int):
     on top of the CPU-interpret gate in tests/test_pkernel.py. On any
     mismatch or kernel failure the XLA number stands and the JSON says
     so (`state_identical` per segment)."""
-    cfg = RaftConfig(seed=42)
+    cfg = _seg_cfg(seed=42)
     (rps, rounds, elapsed, timed_ticks, warmup_s, st_ref, m_ref,
      f_ref) = _timed_chunks(cfg, n_groups, ticks,
                             lambda st, m: total_rounds(m),
@@ -532,6 +554,7 @@ def bench_throughput(n_groups: int, ticks: int):
                        engine),
         **_roofline_fields(cfg, n_groups, engine, timed_ticks, elapsed,
                            nd=pal["nd"] if engine == pal["engine"] else 1),
+        **_packing_fields(cfg),
     }
     emit_manifest("throughput", cfg, device=_device_str(),
                   n_groups=n_groups, **seg)
@@ -553,8 +576,8 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
     full Metrics pytrees (histogram included, hence p50/p99) are
     bit-identical to the XLA path at the same tick. Returns a dict of
     segment results for the bench JSON."""
-    cfg = RaftConfig(seed=seed, crash_prob=0.3, crash_epoch=64,
-                     partition_prob=0.2, partition_epoch=64, drop_prob=0.02)
+    cfg = _seg_cfg(seed=seed, crash_prob=0.3, crash_epoch=64,
+                   partition_prob=0.2, partition_epoch=64, drop_prob=0.02)
     # --- XLA reference: warm the compile on a throwaway universe, then
     # time the real one end-to-end (the histogram needs every tick).
     t0 = time.perf_counter()
@@ -623,6 +646,7 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
         **_mesh_fields(n_groups, nd if engine == k_name else 1),
         **_roofline_fields(cfg, n_groups, engine, ticks, elapsed,
                            nd=nd if engine == k_name else 1),
+        **_packing_fields(cfg),
     }
     emit_manifest(label, cfg, device=_device_str(),
                   **{k: v for k, v in seg.items() if k != "p99_note"})
@@ -647,8 +671,8 @@ def bench_election_rounds(n_groups: int, ticks: int):
     supports ~G x ticks_per_sec / 64 elections/sec, and the observed
     rate should sit near that ceiling (the bench JSON carries the raw
     election count so under-sampling is visible)."""
-    cfg = RaftConfig(seed=44, cmds_per_tick=0, crash_prob=0.5,
-                     crash_epoch=32)
+    cfg = _seg_cfg(seed=44, cmds_per_tick=0, crash_prob=0.5,
+                   crash_epoch=32)
     (eps, elections, elapsed, timed_ticks, warmup_s, st_ref, m_ref,
      f_ref) = _timed_chunks(cfg, n_groups, ticks,
                             lambda st, m: int(m.elections),
@@ -676,6 +700,7 @@ def bench_election_rounds(n_groups: int, ticks: int):
                        engine),
         **_roofline_fields(cfg, n_groups, engine, timed_ticks, elapsed,
                            nd=pal["nd"] if engine == pal["engine"] else 1),
+        **_packing_fields(cfg),
     }
     emit_manifest("election-rounds", cfg, device=_device_str(),
                   n_groups=n_groups, ticks=timed_ticks, **seg)
@@ -692,7 +717,7 @@ def bench_reads(n_groups: int, ticks: int):
     only when the full State pytree (reads_done included) and the full
     Metrics pytree are bit-identical to the XLA path at the same
     tick."""
-    cfg = RaftConfig(seed=45, read_every=4)
+    cfg = _seg_cfg(seed=45, read_every=4)
     (rps, reads, elapsed, timed_ticks, warmup_s, st_ref, m_ref,
      f_ref) = _timed_chunks(
         cfg, n_groups, ticks,
@@ -720,6 +745,7 @@ def bench_reads(n_groups: int, ticks: int):
         **_gate_fields("reads", pal, m_ref, f_ref, n_groups, engine),
         **_roofline_fields(cfg, n_groups, engine, timed_ticks, elapsed,
                            nd=pal["nd"] if engine == pal["engine"] else 1),
+        **_packing_fields(cfg),
     }
     emit_manifest("reads", cfg, device=_device_str(), n_groups=n_groups,
                   ticks=timed_ticks, **seg)
@@ -746,11 +772,11 @@ def bench_clients(seed: int, n_groups: int, ticks: int, label: str):
     exactly-once verdict is asserted per segment: the per-tick safety
     fold (which latches check.client_safety every tick) AND the
     endpoint accounting report must both be clean."""
-    cfg = RaftConfig(seed=seed, sessions=True, cmds_per_tick=0,
-                     client_rate=0.2, client_slots=4,
-                     client_retry_backoff=8,
-                     crash_prob=0.3, crash_epoch=64,
-                     partition_prob=0.2, partition_epoch=64, drop_prob=0.02)
+    cfg = _seg_cfg(seed=seed, sessions=True, cmds_per_tick=0,
+                   client_rate=0.2, client_slots=4,
+                   client_retry_backoff=8,
+                   crash_prob=0.3, crash_epoch=64,
+                   partition_prob=0.2, partition_epoch=64, drop_prob=0.02)
     t0 = time.perf_counter()
     with obs_trace.span(f"warmup+compile xla [{label}]"):
         wst, _, _ = run_recorded(cfg, sim.init(cfg, n_groups=n_groups),
@@ -822,6 +848,7 @@ def bench_clients(seed: int, n_groups: int, ticks: int, label: str):
         **_mesh_fields(n_groups, nd if engine == k_name else 1),
         **_roofline_fields(cfg, n_groups, engine, ticks, elapsed,
                            nd=nd if engine == k_name else 1),
+        **_packing_fields(cfg),
     }
     emit_manifest(label, cfg, device=_device_str(), **seg)
     return seg
@@ -845,7 +872,25 @@ def main():
     ap.add_argument("--heartbeat-every", type=int, default=10,
                     help="chunks between soak-heartbeat snapshots "
                          "(with --trace-dir; default 10)")
+    ap.add_argument("--pack-wire", action="store_true",
+                    help="run every segment with the r13 packed kernel "
+                         "wire (pack_bools + pack_ring + alias_wire; "
+                         "DESIGN.md §13). Promotion gates are unchanged "
+                         "— the packed kernel must still match the XLA "
+                         "reference bit-for-bit — so this is the "
+                         "measured-delta run for the layout ablation")
     args = ap.parse_args()
+
+    if args.pack_wire:
+        # wire_hist stays ON: the fault/client segments' p50/p99 and the
+        # full-Metrics promotion differential both need the in-kernel
+        # histogram rows; the hist dial is a ceiling-run lever
+        # (layout_probe --ablate / multichip_sweep --no-hist), not a
+        # bench default.
+        _WIRE_DIALS.update(pack_bools=True, pack_ring=True,
+                           alias_wire=True)
+        log("packed wire: pack_bools + pack_ring + alias_wire on for "
+            "every segment (wire_hist stays on for the histograms)")
 
     tracer = None
     if args.trace_dir:
@@ -937,10 +982,11 @@ def main():
             missing = [k for k in obs_roofline.ROOFLINE_FIELDS
                        if k not in seg]
             missing += [k for k in SEGMENT_WALL_KEYS if k not in seg]
+            missing += [k for k in PACKING_KEYS if k not in seg]
             if missing:
                 raise RuntimeError(
                     f"bench segment {name!r} lost contract field(s) "
-                    f"{missing} — roofline/wall stamping drifted")
+                    f"{missing} — roofline/wall/packing stamping drifted")
     finally:
         if tracer is not None:
             obs_trace.set_heartbeat(None)

@@ -46,9 +46,11 @@ def audit_report(level: str = "full") -> dict:
     # One derivation per (config, flight) point — the flight-on models
     # double as the report's byte_model block (each derivation is
     # several eval_shape traces; don't pay them twice per startup).
+    # audit_cfgs covers the r12 baselines AND the r13 packed/dialed
+    # layouts, so no number is published off a drifted PACKED wire
+    # either.
     byte_models = {}
-    for label, cfg in (("headline", bytemodel.headline_cfg()),
-                       ("clients", bytemodel.clients_cfg())):
+    for label, cfg in bytemodel.audit_cfgs():
         for wf in (True, False):
             model = bytemodel.derived_wire_model(cfg, with_flight=wf)
             problems += [

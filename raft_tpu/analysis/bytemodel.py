@@ -23,8 +23,13 @@ The model also names every i32-WIDENED bool leaf: a State bool costs
 (Mosaic cannot transport i1 vectors — sim/pkernel.py module
 docstring), so each bool word carries 3 bytes of pure widening waste
 (~690 B/group at the headline config, the "~700 B" of the r08 probe).
-The waste is structural until the packed-layout work (ROADMAP item 2)
-lands; the report is its measured starting point.
+Since r13 that waste is a DIAL, not a structure: under the
+`pack_bools` / `pack_ring` layout knobs (DESIGN.md §13) this module
+derives the PACKED arithmetic independently — bit lanes for the bool
+leaves, half-lane ring deltas plus a base lane — and the three-way
+reconciliation holds at every audited layout (`audit_cfgs`: the 8,308 /
+11,056 B/group r12 baselines exactly preserved as the off-path pins,
+7,136 / 9,884 packed, 3,552 with every dial at the headline config).
 """
 
 from __future__ import annotations
@@ -107,6 +112,16 @@ def derived_wire_model(cfg: RaftConfig, with_flight: bool = True) -> dict:
 
     rows = []
     state_words = 0
+    # The packed-layout arithmetic (DESIGN.md §13), derived here
+    # INDEPENDENTLY from the leaf dtypes/shapes so a drifted encode in
+    # pkernel's registry cannot agree with itself: under pack_bools a
+    # bool leaf's wire words come from its own trailing axis packed
+    # into bit lanes (votes: k per-node lanes; alive_prev: 1; the
+    # mailbox bools: ONE shared-lane leaf of ceil(n_bool x k / 32)
+    # words per dst, emitted after the walk); under pack_ring the
+    # log_term ring carries two 16-bit deltas per word plus a one-word
+    # base/overflow lane.
+    n_mb_bools = 0
     for name, leaf in iter_named_leaves(st):
         shape = tuple(leaf.shape)
         if not shape or shape[0] != _G0:
@@ -118,18 +133,57 @@ def derived_wire_model(cfg: RaftConfig, with_flight: bool = True) -> dict:
         per_group = shape[1:]
         words = int(np.prod(per_group, dtype=np.int64)) if per_group else 1
         itemsize = np.dtype(leaf.dtype).itemsize
-        widened = np.dtype(leaf.dtype) == np.bool_
+        is_bool = np.dtype(leaf.dtype) == np.bool_
         if np.dtype(leaf.dtype).itemsize > 4:
             problems.append(
                 f"state leaf {name}: dtype {leaf.dtype} is wider than the "
                 f"32-bit wire lane — kinit would silently truncate it")
+        wire_words, packed = words, False
+        if cfg.pack_bools and is_bool:
+            packed = True
+            if name.startswith("mailbox."):
+                n_mb_bools += 1     # shared-lane leaf emitted below
+                wire_words = 0
+            elif name == "nodes.votes":
+                wire_words = int(per_group[0])   # k per-node bit lanes
+            elif name == "alive_prev":
+                wire_words = 1
+            else:
+                problems.append(
+                    f"state leaf {name}: bool leaf with no packed-layout "
+                    f"rule — the pack_bools encode would drop it")
+        if cfg.pack_ring and name == "nodes.log_term":
+            if words % 2:
+                problems.append(f"state leaf {name}: odd ring cannot pack "
+                                f"two 16-bit deltas per word")
+            wire_words, packed = words // 2, True
         rows.append({
             "name": name, "kind": "state", "dtype": str(np.dtype(leaf.dtype)),
             "shape_per_group": list(per_group),
-            "wire_words": words, "wire_bytes": 4 * words,
-            "native_bytes": itemsize * words, "widened_bool": bool(widened),
+            "wire_words": wire_words, "wire_bytes": 4 * wire_words,
+            "native_bytes": itemsize * words,
+            "widened_bool": bool(is_bool and not packed),
+            "packed": packed,
         })
-        state_words += words
+        state_words += wire_words
+    if cfg.pack_bools:
+        from raft_tpu.sim.pkernel import MB_BOOLS_PACKED
+        mb_words = -(-n_mb_bools * cfg.k // 32) * cfg.k
+        rows.append({
+            "name": MB_BOOLS_PACKED, "kind": "state-packed",
+            "dtype": "int32", "shape_per_group": [cfg.k],
+            "wire_words": mb_words, "wire_bytes": 4 * mb_words,
+            "native_bytes": 0, "widened_bool": False, "packed": True,
+        })
+        state_words += mb_words
+    if cfg.pack_ring:
+        from raft_tpu.sim.pkernel import RING_BASE
+        rows.append({
+            "name": RING_BASE, "kind": "state-packed", "dtype": "int32",
+            "shape_per_group": [], "wire_words": 1, "wire_bytes": 4,
+            "native_bytes": 0, "widened_bool": False, "packed": True,
+        })
+        state_words += 1
 
     # Metric tail: every active non-row leaf is ONE per-group lane on
     # the wire (scalars like `elections` accumulate per group in-kernel
@@ -162,6 +216,7 @@ def derived_wire_model(cfg: RaftConfig, with_flight: bool = True) -> dict:
             "dtype": str(np.dtype(leaf.dtype)), "shape_per_group": [],
             "wire_words": words, "wire_bytes": 4 * words,
             "native_bytes": 4 * words, "widened_bool": False,
+            "packed": False,
         })
         metric_words += words
 
@@ -178,6 +233,7 @@ def derived_wire_model(cfg: RaftConfig, with_flight: bool = True) -> dict:
                 "dtype": str(np.dtype(leaf.dtype)), "shape_per_group": [],
                 "wire_words": RING, "wire_bytes": 4 * RING,
                 "native_bytes": 4 * RING, "widened_bool": False,
+                "packed": False,
             })
             flight_words += RING
 
@@ -276,18 +332,39 @@ def derived_wire_model(cfg: RaftConfig, with_flight: bool = True) -> dict:
         },
         "hbm": {"ceiling_groups": ceiling,
                 "boundary_exact": bool(hbm_ok),
-                "limit_bytes": pkernel.HBM_LIMIT_BYTES},
+                "limit_bytes": pkernel.HBM_LIMIT_BYTES,
+                # 2 = in+out buffers live across a launch; 1 under the
+                # alias_wire dial (input/output aliasing + donation).
+                "residency_buffers": pkernel._residency_buffers(cfg)},
         "problems": problems,
     }
 
 
+def audit_cfgs() -> list:
+    """(label, cfg) pairs every audit derives and reconciles: the two
+    published baselines (8,308 B/group headline, 11,056 B/group client
+    universe — the r13 off-path pins) plus their packed/dialed variants
+    (7,136 / 9,884 B/group packed; the all-dials ceiling-run layout) —
+    one list, shared by `byte_model_problems` and
+    `analysis.audit_report` so the packed layouts are audited wherever
+    the baselines are."""
+    packed = dict(pack_bools=True, pack_ring=True)
+    return [
+        ("headline", headline_cfg()),
+        ("clients", clients_cfg()),
+        ("headline-packed", dataclasses.replace(headline_cfg(), **packed)),
+        ("clients-packed", dataclasses.replace(clients_cfg(), **packed)),
+        ("headline-ceiling", dataclasses.replace(
+            headline_cfg(), alias_wire=True, wire_hist=False, **packed)),
+    ]
+
+
 def byte_model_problems() -> list[str]:
-    """The audit entry point: derive + reconcile the two configs every
-    published wire number rides on (the 8,308 B/group headline and the
-    11,056 B/group client universe), flight on and off."""
+    """The audit entry point: derive + reconcile every config a
+    published wire number rides on — the r12 baselines AND the r13
+    packed/dialed layouts (`audit_cfgs`), flight on and off."""
     out = []
-    for label, cfg in (("headline", headline_cfg()),
-                       ("clients", clients_cfg())):
+    for label, cfg in audit_cfgs():
         for wf in (True, False):
             model = derived_wire_model(cfg, with_flight=wf)
             out.extend(f"byte model [{label}, flight={'on' if wf else 'off'}]"

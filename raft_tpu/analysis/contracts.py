@@ -569,6 +569,155 @@ def checkpoint_problems(ckpt_mod=None,
     return problems
 
 
+# ------------------------------------------------------ packed wire layout
+
+
+def _packed_cfgs() -> dict:
+    """label -> a config exercising each r13 layout dial combination
+    the packing pass audits (built on the small `_base_cfg` universe
+    so every derived check stays eval_shape-cheap)."""
+    base = _base_cfg()
+    return {
+        "pack_bools": dataclasses.replace(base, pack_bools=True),
+        "pack_ring": dataclasses.replace(base, pack_ring=True),
+        "packed": dataclasses.replace(base, pack_bools=True,
+                                      pack_ring=True),
+        "ceiling": dataclasses.replace(base, pack_bools=True,
+                                       pack_ring=True, alias_wire=True,
+                                       wire_hist=False),
+        "packed-clients": dataclasses.replace(
+            _gate_cfgs()["clients"], pack_bools=True, pack_ring=True),
+    }
+
+
+def packing_problems(include_behavioral: bool = True) -> list[str]:
+    """The r13 packed-wire contracts (DESIGN.md §13):
+
+    - layout dials are LAYOUT-ONLY — flipping any of them changes zero
+      State pytree leaves (the XLA/oracle programs cannot see them);
+    - the packed wire registry's leaf count matches independent
+      arithmetic (mailbox bools collapse to ONE shared lane, pack_ring
+      adds exactly the base lane) and the real kinit output under
+      eval_shape emits exactly that many leaves, every one in the
+      folded [..., GS, LANE] layout `kleaf_spec` shards;
+    - the wire_hist dial drops exactly the [H]-row metric leaves;
+    - (behavioral) `_pack_wire`/`_unpack_wire` round-trip a synthetic
+      non-trivial wire EXACTLY, and a checkpoint written under one
+      layout loads under any other (config.LAYOUT_FIELDS are excluded
+      from the semantic match).
+    """
+    import jax
+    import numpy as np
+
+    from raft_tpu import sim
+    from raft_tpu.clients.state import CLIENT_LEAVES
+    from raft_tpu.obs.recorder import flight_init
+    from raft_tpu.sim import pkernel
+    from raft_tpu.sim.pkernel import LANE, ROW_METRIC_LEAVES
+
+    problems = []
+    base = _base_cfg()
+    base_names = _leaf_names(base)
+    for label, cfg in _packed_cfgs().items():
+        # Dials never touch the State pytree (clients gate aside —
+        # compare against the matching packing-off config).
+        off = dataclasses.replace(
+            cfg, pack_bools=False, pack_ring=False, alias_wire=False,
+            wire_hist=True)
+        ref_names = base_names if off == base else _leaf_names(off)
+        if _leaf_names(cfg) != ref_names:
+            problems.append(
+                f"[{label}] layout dials changed State pytree leaves — "
+                f"they must be invisible to the XLA/oracle engines")
+        # Independent leaf-count arithmetic vs the packed registry.
+        n_mb_bools = len([f for f in pkernel._mb_fields(cfg)
+                          if f in pkernel._MB_BOOL])
+        expect = (len(pkernel._node_leaves(cfg))
+                  + len(pkernel._mb_fields(cfg)) + 2
+                  + (len(CLIENT_LEAVES) if cfg.clients_u32 else 0))
+        if cfg.pack_bools:
+            expect -= n_mb_bools - 1     # bools collapse to ONE lane leaf
+        if cfg.pack_ring:
+            expect += 1                  # the base/overflow lane
+        if pkernel._n_state_leaves(cfg) != expect:
+            problems.append(
+                f"[{label}] packed wire registry has "
+                f"{pkernel._n_state_leaves(cfg)} state leaves; independent "
+                f"arithmetic expects {expect}")
+        # Real kinit output: count AND folded layout (the shard rule).
+        st = jax.eval_shape(lambda c=cfg: sim.init(c, n_groups=2))
+        fl = jax.eval_shape(lambda: flight_init(2))
+        leaves = jax.eval_shape(
+            lambda s, f, c=cfg: pkernel.kinit(c, s, None, f)[0], st, fl)
+        want_n = (pkernel._n_state_leaves(cfg) + 6
+                  + pkernel._n_metric_leaves(cfg))
+        if len(leaves) != want_n:
+            problems.append(
+                f"[{label}] kinit emitted {len(leaves)} wire leaves; the "
+                f"packed registries promise {want_n}")
+        for i, leaf in enumerate(leaves):
+            shape = tuple(leaf.shape)
+            if len(shape) < 2 or shape[-1] != LANE \
+                    or shape[-2] % pkernel.SUB:
+                problems.append(
+                    f"[{label}] wire leaf #{i}: shape {shape} is not the "
+                    f"folded [..., GS, {LANE}] layout kleaf_spec shards")
+        # wire_hist drops exactly the row leaves.
+        no_hist = dataclasses.replace(cfg, wire_hist=False)
+        want_active = tuple(n for n in pkernel._active_metric_leaves(cfg)
+                            if n not in ROW_METRIC_LEAVES)
+        if pkernel._active_metric_leaves(no_hist) != want_active:
+            problems.append(
+                f"[{label}] wire_hist=False active metric leaves "
+                f"{pkernel._active_metric_leaves(no_hist)} != "
+                f"{want_active} (must drop exactly the [H]-row leaves)")
+
+    # Behavioral: exact pack/unpack round trip on a synthetic wire
+    # whose every lane is distinct-ish (zeros would round-trip through
+    # a BROKEN encode too), and the cross-layout checkpoint load.
+    if not include_behavioral:
+        return problems
+    import jax.numpy as jnp
+
+    for label in ("packed", "packed-clients"):
+        cfg = _packed_cfgs()[label]
+        st = sim.init(cfg, n_groups=LANE)
+        flat = pkernel._to_kstate(cfg, st)
+        # Fill every lane with a distinct deterministic pattern; bool
+        # wire lanes (the bit-pack inputs) clamp to {0, 1}.
+        names = pkernel._unpacked_names(cfg)
+        booly = set(pkernel._MB_BOOL) | {"votes", "alive_prev"}
+        synth = []
+        for i, (n, a) in enumerate(zip(names, flat)):
+            v = (np.arange(a.size, dtype=np.int64) * (2 * i + 3)) % 5
+            if n in booly:
+                v = v % 2
+            synth.append(jnp.asarray(v.reshape(a.shape), jnp.int32))
+        back, _ = pkernel._unpack_wire(cfg, pkernel._pack_wire(cfg, synth))
+        for n, a, b in zip(names, synth, back):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                problems.append(
+                    f"[{label}] pack/unpack round trip changed wire leaf "
+                    f"{n!r} — the encode is not lossless")
+    # A checkpoint saved under one layout loads under another (and a
+    # pre-r13 file — no layout keys at all — loads under a packed cfg).
+    from raft_tpu.sim import checkpoint as ckpt
+    cfg_off = _base_cfg()
+    cfg_on = _packed_cfgs()["packed"]
+    st = sim.init(cfg_off, n_groups=2)
+    buf = io.BytesIO()
+    ckpt.save(buf, st, 3, cfg=cfg_off)
+    buf.seek(0)
+    try:
+        ckpt.load(buf, cfg=cfg_on)
+    except Exception as e:  # noqa: BLE001 — audited, not handled
+        problems.append(
+            f"cross-layout checkpoint load raised {type(e).__name__}: {e} "
+            f"— config.LAYOUT_FIELDS must be excluded from the semantic "
+            f"match (a packed run could never resume a pre-r13 file)")
+    return problems
+
+
 # ------------------------------------------------------- manifest schema
 
 
@@ -586,12 +735,27 @@ def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
     man = real_manifest if manifest_mod is None else manifest_mod
     hist = real_history if history_mod is None else history_mod
     problems = []
-    keys = real_manifest.ROOFLINE_KEYS
-    if tuple(real_history.R12_MANIFEST_KEYS) != tuple(keys):
+    keys = real_manifest.ROOFLINE_KEYS + real_manifest.PACKING_KEYS
+    if tuple(real_history.R12_MANIFEST_KEYS) \
+            != tuple(real_manifest.ROOFLINE_KEYS):
         problems.append(
             f"obs.history.R12_MANIFEST_KEYS {real_history.R12_MANIFEST_KEYS}"
-            f" != obs.manifest.ROOFLINE_KEYS {keys} — the emit-side and "
+            f" != obs.manifest.ROOFLINE_KEYS "
+            f"{real_manifest.ROOFLINE_KEYS} — the emit-side and "
             f"backfill-side key lists drifted")
+    if tuple(real_history.R13_MANIFEST_KEYS) \
+            != tuple(real_manifest.PACKING_KEYS):
+        problems.append(
+            f"obs.history.R13_MANIFEST_KEYS {real_history.R13_MANIFEST_KEYS}"
+            f" != obs.manifest.PACKING_KEYS "
+            f"{real_manifest.PACKING_KEYS} — the emit-side and "
+            f"backfill-side key lists drifted")
+    from raft_tpu.config import LAYOUT_FIELDS
+    if tuple(real_manifest.PACKING_KEYS) != tuple(LAYOUT_FIELDS):
+        problems.append(
+            f"obs.manifest.PACKING_KEYS {real_manifest.PACKING_KEYS} != "
+            f"config.LAYOUT_FIELDS {LAYOUT_FIELDS} — a layout dial exists "
+            f"that manifests would not record")
     rec = man.emit_manifest("audit-probe", _base_cfg(), path="-")
     for k in keys + ("mesh_shape", "groups_per_device"):
         if k not in rec:
@@ -602,12 +766,15 @@ def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
             problems.append(
                 f"manifest default for {k!r} is {rec[k]!r}, not null — "
                 f"an unstamped record would claim a value")
-    # Caller-filled roofline values must survive emission.
+    # Caller-filled roofline AND wire-layout values must survive
+    # emission.
     rec2 = man.emit_manifest("audit-probe", _base_cfg(), path="-",
                              bound="hbm", attainment_pct=12.5,
-                             predicted_rounds_per_sec=1.0)
+                             predicted_rounds_per_sec=1.0,
+                             pack_bools=True, wire_hist=False)
     for k, want in (("bound", "hbm"), ("attainment_pct", 12.5),
-                    ("predicted_rounds_per_sec", 1.0)):
+                    ("predicted_rounds_per_sec", 1.0),
+                    ("pack_bools", True), ("wire_hist", False)):
         if rec2.get(k) != want:
             problems.append(f"manifest dropped the caller's {k!r} value "
                             f"({rec2.get(k)!r} != {want!r})")
@@ -671,6 +838,7 @@ def contract_problems(include_behavioral: bool = True) -> list[str]:
     out += wire_registry_problems()
     out += gating_problems()
     out += shard_rule_problems()
+    out += packing_problems(include_behavioral=include_behavioral)
     out += checkpoint_problems(include_behavioral=include_behavioral)
     out += manifest_problems()
     out += rng_parity_problems()

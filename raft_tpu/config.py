@@ -60,6 +60,16 @@ def session_payload(sid: int, seq: int, val: int) -> int:
             | (seq << SESSION_SEQ_SHIFT) | (val & SESSION_VAL_MASK))
 
 
+# Kernel wire-LAYOUT knobs: fields of RaftConfig that change how the
+# Pallas kernel lays state out in HBM (packing, buffer donation,
+# telemetry rows) but never what any engine computes per tick. One
+# registry, consumed by checkpoint.load (configs match modulo these —
+# a packed run may resume an unpacked file and vice versa), by the
+# bench/sweep manifests (recorded per segment), and by the contract
+# auditor (flipping one must change zero State pytree leaves).
+LAYOUT_FIELDS = ("pack_bools", "pack_ring", "alias_wire", "wire_hist")
+
+
 def _prob_to_u32(p: float) -> int:
     """Map a probability to a uint32 threshold: event iff hash < threshold.
 
@@ -155,6 +165,38 @@ class RaftConfig:
     # from both backends' programs (no new messages, identical traces).
     prevote: bool = False
 
+    # Kernel wire-layout dials (DESIGN.md §13). LAYOUT-ONLY knobs: none
+    # of them changes tick semantics — the CPU oracle and the XLA scan
+    # ignore them entirely, and the kernel packs/unpacks only at chunk
+    # boundaries so per-tick state stays bit-identical across engines.
+    # All default off/on such that the default wire, checkpoints, and
+    # compiled programs are byte-identical to pre-r13 builds
+    # (LAYOUT_FIELDS below; checkpoint.load matches configs modulo
+    # these fields for the same reason).
+    #
+    # pack_bools: bit-pack the i32-widened bool wire leaves — the
+    #   [K, K] mailbox presence/grant/success masks share i32 lanes
+    #   (bit = field x src), votes packs its peer axis, alive_prev its
+    #   node axis (−856 B/group at the headline config).
+    # pack_ring: delta-encode the log_term ring against a per-chunk
+    #   per-group base in 16-bit half-lanes (2 slots/word, −316 B/group
+    #   at headline; requires an even log_cap). Lossless while the
+    #   in-group term spread stays under 2^16; overflow latches a
+    #   sticky bit that kfinish refuses loudly (never silent corruption).
+    # alias_wire: input/output-alias the fused-chunk pallas_call (and
+    #   donate the wire operands through jit/shard_map), so ONE copy of
+    #   the wire is resident instead of in+out — halves the HBM
+    #   residency model behind supported()/hbm_ceiling_groups.
+    # wire_hist: carry the in-kernel per-group [H]-row histogram(s) on
+    #   the wire (2,048 B/group each). False is the ceiling-run dial:
+    #   the kernel stops tracking election/ack latency histograms
+    #   (Metrics.hist passes through unchanged) — telemetry as a dial,
+    #   not a tax (DESIGN.md §9 "next levers").
+    pack_bools: bool = False
+    pack_ring: bool = False
+    alias_wire: bool = False
+    wire_hist: bool = True
+
     def __post_init__(self):
         assert not self.sessions or self.cmds_per_tick == 0, (
             "sessions=True needs cmds_per_tick=0: scheduled payloads hash "
@@ -195,6 +237,10 @@ class RaftConfig:
         assert self.election_min > 2 * self.heartbeat_every, (
             "election timeout must comfortably exceed the heartbeat cadence "
             "or steady-state leadership is impossible"
+        )
+        assert not self.pack_ring or self.log_cap % 2 == 0, (
+            "pack_ring packs two ring-term deltas per i32 word, so "
+            "log_cap must be even"
         )
 
     @property

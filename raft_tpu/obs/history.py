@@ -57,6 +57,12 @@ from raft_tpu.obs.roofline import engine_class  # noqa: F401  (re-export)
 R12_MANIFEST_KEYS = ("predicted_rounds_per_sec", "attainment_pct",
                      "bound", "trace_path")
 
+# Manifest keys added by the r13 packed-wire layer (the kernel layout
+# dials a segment ran with) — same present-from-birth / backfilled-as-
+# null contract as the r12 keys. Its own literal (the registry idiom),
+# proven equal to obs.manifest.PACKING_KEYS by the auditor.
+R13_MANIFEST_KEYS = ("pack_bools", "pack_ring", "alias_wire", "wire_hist")
+
 # Manifest records below this group count are smoke/--quick shapes:
 # correctness drives, not trajectory points — a 1K-group quick run's
 # rate joining the 100K series would trip (or mask) the regression
@@ -106,11 +112,12 @@ def _round_of(path: str) -> int | None:
 
 
 def backfill_record(rec: dict) -> dict:
-    """A manifest record normalized to the r12 schema: the roofline/
-    trace keys present-but-null when the record predates them (same
-    rule as the mesh keys at r08). Returns a new dict."""
+    """A manifest record normalized to the current schema: the r12
+    roofline/trace keys AND the r13 wire-layout keys present-but-null
+    when the record predates them (same rule as the mesh keys at r08).
+    Returns a new dict."""
     out = dict(rec)
-    for k in R12_MANIFEST_KEYS:
+    for k in R12_MANIFEST_KEYS + R13_MANIFEST_KEYS:
         out.setdefault(k, None)
     return out
 

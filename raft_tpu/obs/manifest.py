@@ -57,11 +57,28 @@ DEFAULT_PATH = "bench_manifest.jsonl"
 ROOFLINE_KEYS = ("predicted_rounds_per_sec", "attainment_pct", "bound",
                  "trace_path")
 
+# r13 wire-layout keys (config.LAYOUT_FIELDS by name): which packing /
+# aliasing / telemetry dials the segment's KERNEL engine ran with —
+# top-level so a reader pricing a rate against a byte model never digs
+# through the config dict (and a pre-r13 record, which could only have
+# run the unpacked wire, reads as null = "pre-dial schema", same rule
+# as the r8 mesh keys and the r12 roofline keys; obs.history backfills
+# them on read, proven both directions by the auditor's manifest pass).
+PACKING_KEYS = ("pack_bools", "pack_ring", "alias_wire", "wire_hist")
+
 
 def config_hash(cfg) -> str:
-    """Stable short hash of the semantic config — two runs with equal
-    hashes simulated the same universe schedule (same seed included)."""
-    blob = json.dumps(dataclasses.asdict(cfg), sort_keys=True)
+    """Stable short hash of the SEMANTIC config — two runs with equal
+    hashes simulated the same universe schedule (same seed included).
+    The r13 wire-layout dials (config.LAYOUT_FIELDS) are excluded:
+    they never change what any engine computes, and the packed-vs-
+    unpacked ablation pair for one universe must hash equal to be
+    pairable (the dials themselves are recorded via PACKING_KEYS)."""
+    from raft_tpu.config import LAYOUT_FIELDS
+    d = dataclasses.asdict(cfg)
+    for k in LAYOUT_FIELDS:
+        d.pop(k, None)
+    blob = json.dumps(d, sort_keys=True)
     return hashlib.sha256(blob.encode()).hexdigest()[:12]
 
 
@@ -96,7 +113,7 @@ def emit_manifest(segment: str, cfg, device: str | None = None,
            # on one chip" from "device count unrecorded". The r12
            # roofline/trace keys follow the same rule.
            "mesh_shape": None, "groups_per_device": None,
-           **{k: None for k in ROOFLINE_KEYS}}
+           **{k: None for k in ROOFLINE_KEYS + PACKING_KEYS}}
     rec.update(fields)
     path = path or os.environ.get(MANIFEST_ENV) or DEFAULT_PATH
     if path != "-":

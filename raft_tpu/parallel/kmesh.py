@@ -43,7 +43,7 @@ from raft_tpu.config import RaftConfig
 from raft_tpu.obs.recorder import Flight
 from raft_tpu.parallel.mesh import AXIS, _shard_map
 from raft_tpu.sim import pkernel
-from raft_tpu.sim.run import Metrics
+from raft_tpu.sim.run import HIST_SIZE, Metrics
 from raft_tpu.sim.state import I32, State
 
 
@@ -85,18 +85,29 @@ def kinit_sharded(cfg: RaftConfig, st: State, mesh: Mesh,
     return shard_kleaves(leaves, mesh), g
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("cfg", "n_ticks", "mesh", "interpret"))
-def _kstep_sharded(cfg, mesh, t0, leaves, n_ticks, interpret):
+def _kstep_sharded_impl(cfg, mesh, t0, leaves, n_ticks, interpret):
     specs = tuple(kleaf_spec(a) for a in leaves)
 
     def local(t0s, *lvs):
-        return pkernel._prun_padded(cfg, tuple(lvs), t0s, n_ticks,
-                                    interpret=interpret)
+        return pkernel._prun_padded_impl(cfg, tuple(lvs), t0s, n_ticks,
+                                         interpret=interpret)
 
     f = _shard_map(local, mesh=mesh, in_specs=(P(),) + specs,
                    out_specs=specs)
     return f(t0, *leaves)
+
+
+_STEP_STATICS = ("cfg", "n_ticks", "mesh", "interpret")
+_kstep_sharded = jax.jit(_kstep_sharded_impl,
+                         static_argnames=_STEP_STATICS)
+# Donating twin for cfg.alias_wire (DESIGN.md §13): the wire operands'
+# buffers are released to the sharded launch — together with the
+# pallas_call's input_output_aliases inside, one wire copy is resident
+# per device instead of in+out. Same consumed-operand contract as
+# pkernel.kstep.
+_kstep_sharded_donate = jax.jit(_kstep_sharded_impl,
+                                static_argnames=_STEP_STATICS,
+                                donate_argnums=(3,))
 
 
 def kstep_sharded(cfg: RaftConfig, leaves, t0: int, n_ticks: int,
@@ -105,10 +116,13 @@ def kstep_sharded(cfg: RaftConfig, leaves, t0: int, n_ticks: int,
     device runs the kernel grid over its own blocks, no collectives.
     `t0` stays traced, so chunked calls at advancing t0 reuse ONE
     compiled sharded program — the property the bench's timed region
-    depends on."""
-    return tuple(_kstep_sharded(cfg, mesh, jnp.asarray(int(t0), I32),
-                                tuple(leaves), int(n_ticks),
-                                bool(interpret)))
+    depends on. Under `cfg.alias_wire` (compiled path) the input
+    leaves are donated — stale after the call, the way every chunk
+    loop already treats them."""
+    fn = _kstep_sharded_donate if (cfg.alias_wire and not interpret) \
+        else _kstep_sharded
+    return tuple(fn(cfg, mesh, jnp.asarray(int(t0), I32),
+                    tuple(leaves), int(n_ticks), bool(interpret)))
 
 
 class GlobalKMetrics(NamedTuple):
@@ -124,11 +138,12 @@ class GlobalKMetrics(NamedTuple):
     # dropped (psum); 0 = the whole sharded run was a clean soak
 
 
-@functools.partial(jax.jit, static_argnames=("g", "mesh"))
-def _kglobal_sharded(mesh, g, gid, mc, me, mh, mx, ms):
-    specs = tuple(kleaf_spec(a) for a in (gid, mc, me, mh, mx, ms))
+@functools.partial(jax.jit, static_argnames=("g", "mesh", "with_hist"))
+def _kglobal_sharded(mesh, g, with_hist, gid, mc, me, mx, ms, mh=None):
+    operands = (gid, mc, me, mx, ms) + ((mh,) if with_hist else ())
+    specs = tuple(kleaf_spec(a) for a in operands)
 
-    def local(gid, mc, me, mh, mx, ms):
+    def local(gid, mc, me, mx, ms, mh=None):
         real = gid < g
 
         def tot(a):
@@ -137,8 +152,14 @@ def _kglobal_sharded(mesh, g, gid, mc, me, mh, mx, ms):
         return GlobalKMetrics(
             rounds=tot(mc),
             elections=tot(me),
-            hist=jax.lax.psum(
-                jnp.sum(jnp.where(real[None], mh, 0), axis=(1, 2)), AXIS),
+            # Under the wire_hist dial no [H] rows exist on the wire —
+            # the reduced histogram is the same all-zeros row khist
+            # would be summing (a ceiling run trades percentiles away;
+            # the scalar counters stay exact).
+            hist=(jax.lax.psum(
+                jnp.sum(jnp.where(real[None], mh, 0), axis=(1, 2)), AXIS)
+                if with_hist else
+                jax.lax.psum(jnp.zeros((HIST_SIZE,), I32), AXIS)),
             max_latency=jax.lax.pmax(
                 jnp.max(jnp.where(real, mx, 0)), AXIS),
             unsafe=tot(1 - ms),
@@ -146,7 +167,7 @@ def _kglobal_sharded(mesh, g, gid, mc, me, mh, mx, ms):
 
     f = _shard_map(local, mesh=mesh, in_specs=specs,
                    out_specs=GlobalKMetrics(P(), P(), P(), P(), P()))
-    return f(gid, mc, me, mh, mx, ms)
+    return f(*operands)
 
 
 def kglobal_sharded(cfg: RaftConfig, leaves, g: int, mesh: Mesh
@@ -157,12 +178,15 @@ def kglobal_sharded(cfg: RaftConfig, leaves, g: int, mesh: Mesh
     before the reduction, so the counters equal the host-side
     `kcommitted`/`kelections`/`khist` values exactly (i32 adds
     reassociate). Module-level jit (like `_kstep_sharded`): repeated
-    calls at one (g, mesh, shape) reuse a single compiled reduction."""
+    calls at one (g, mesh, shape) reuse a single compiled reduction.
+    Follows the cfg layout dials: with `wire_hist` off the histogram
+    row comes back all-zeros (nothing was tracked)."""
     gid = leaves[pkernel._n_state_leaves(cfg) - 1]
     tail = [pkernel._mleaf(cfg, leaves, n)
-            for n in ("committed", "elections", "hist", "max_latency",
-                      "safety")]
-    return _kglobal_sharded(mesh, int(g), gid, *tail)
+            for n in ("committed", "elections", "max_latency", "safety")]
+    if cfg.wire_hist:
+        tail.append(pkernel._mleaf(cfg, leaves, "hist"))
+    return _kglobal_sharded(mesh, int(g), bool(cfg.wire_hist), gid, *tail)
 
 
 def prun_sharded(cfg: RaftConfig, st: State, n_ticks: int, mesh: Mesh,

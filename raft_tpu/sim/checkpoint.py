@@ -152,6 +152,15 @@ def load(path, cfg: Optional[RaftConfig] = None, sharding=None
                 dataclasses.asdict(RaftConfig())))
             for k, v in defaults.items():
                 saved.setdefault(k, v)
+            # Kernel wire-LAYOUT knobs (config.LAYOUT_FIELDS) never
+            # change what any engine computes, and checkpoints store
+            # the layout-free State pytree — a packed run may resume an
+            # unpacked file (incl. every pre-r13 file) and vice versa,
+            # so they are excluded from the semantic match.
+            from raft_tpu.config import LAYOUT_FIELDS
+            for k in LAYOUT_FIELDS:
+                saved.pop(k, None)
+                want.pop(k, None)
             if saved != want:
                 diff = {k: (saved.get(k), want.get(k))
                         for k in set(saved) | set(want)

@@ -86,6 +86,17 @@ failure; tests/test_pkernel.py guards them):
 - no i1 transposes (mask relayout materializes constants LLO cannot
   build): the per-node outbox widens to i32 BEFORE the vmap stacking
   transpose, and dead-sender erasure uses `where` on the i32 slots.
+
+Packed wire (DESIGN.md §13): the HBM wire form is further shrunk by
+four cfg LAYOUT dials — bit-packed bool lanes (`pack_bools`), 16-bit
+delta-encoded ring terms (`pack_ring`), input/output-aliased + donated
+buffers (`alias_wire`), and histogram-row opt-out (`wire_hist`). The
+encode/decode happens ONLY at chunk boundaries (`_pack_wire` /
+`_unpack_wire`, shared host/kernel), so everything above this
+paragraph — the tick, the metrics fold, the bit-identity contract —
+is layout-blind; with every dial off the wire is byte-identical to
+pre-r13. `_wire_state_leaves` is the packed-layout registry every
+byte model derives from.
 """
 
 from __future__ import annotations
@@ -128,21 +139,91 @@ HBM_LIMIT_BYTES = int(_os.environ.get("RAFT_TPU_HBM_BYTES",
                                       16 * 1024 ** 3))
 
 
+def _kind_words(cfg: RaftConfig, kind: str) -> int:
+    return {"scalar": 1, "peer": cfg.k, "ring": cfg.log_cap,
+            "sess": cfg.client_slots}[kind]
+
+
+# Names of the SYNTHETIC wire leaves the packed layout introduces —
+# shared with analysis/bytemodel.py's report rows and the ablation
+# probe so every surface names the packed lanes identically.
+MB_BOOLS_PACKED = "mailbox[bools packed]"
+RING_BASE = "log_term[ring base]"
+
+
+def _wire_state_leaves(cfg: RaftConfig) -> list:
+    """(name, i32 words/group) per wire leaf of the STATE section, in
+    wire order — THE packed-layout registry (DESIGN.md §13). With every
+    layout dial off this is exactly the r12 wire: node leaves, mailbox
+    leaves, client-state leaves, alive_prev, group_id, one i32 word per
+    element. The dials rewrite entries in place:
+
+    - pack_bools: `votes` packs its peer axis into per-node bit lanes
+      (k*k -> k words); ALL bool mailbox leaves collapse into one
+      shared-lane leaf at the first bool field's position (bit =
+      field x src, ceil(n_bool * k / 32) words per dst); alive_prev
+      packs its node axis (k -> 1 word).
+    - pack_ring: `log_term` carries 16-bit deltas two-per-word
+      (k*L -> k*L/2) plus one per-group base lane (bit 31 = the sticky
+      delta-overflow flag kfinish refuses on).
+    """
+    out = []
+    mbb = set(_mb_bool_fields(cfg)) if cfg.pack_bools else set()
+    for f, kind in _node_leaves(cfg):
+        if cfg.pack_bools and f == "votes":
+            out.append(("votes", cfg.k))
+        elif cfg.pack_ring and f == "log_term":
+            out.append(("log_term", cfg.k * cfg.log_cap // 2))
+            out.append((RING_BASE, 1))
+        else:
+            out.append((f, cfg.k * _kind_words(cfg, kind)))
+    packed_emitted = False
+    for f in _mb_fields(cfg):
+        if f in mbb:
+            if not packed_emitted:
+                w = -(-len(mbb) * cfg.k // 32)   # words per dst node
+                out.append((MB_BOOLS_PACKED, w * cfg.k))
+                packed_emitted = True
+            continue
+        out.append((f, cfg.k * cfg.k * (cfg.client_slots
+                                        if f == "is_req_snap_sessions"
+                                        else 1)))
+    if cfg.clients_u32:
+        out.extend((f, cfg.client_slots) for f in CLIENT_LEAVES)
+    out.append(("alive_prev", 1 if cfg.pack_bools else cfg.k))
+    out.append(("group_id", 1))
+    return out
+
+
+def _wire_index(cfg: RaftConfig, name: str) -> int:
+    """Position of a named leaf in the wire tuple's state section —
+    the packed layout inserts/removes leaves, so host-side readers
+    (kreads) index by NAME, never by a registry-order constant."""
+    return [n for n, _ in _wire_state_leaves(cfg)].index(name)
+
+
 def _state_words_per_group(cfg: RaftConfig) -> int:
-    """i32 words per group of the NON-ROW wire leaves: node state
-    (incl. the two [K, S] session tables with clients on), mailbox
-    (incl. the [K, K, S] InstallSnapshot session payload), the [S]
-    client-state leaves, alive_prev ([K, ...]: k words), group_id, and
-    the per-group metric lanes (every metric leaf except the [H]-row
-    histograms). The one accumulation both byte models share — the
-    VMEM and HBM predicates drifted apart once (alive_prev counted as
-    1 word in one copy) and tests/test_kmesh.py pins this shared form
-    against real kinit leaves, clients off AND on."""
+    """i32 words per group of the NON-ROW wire leaves: the packed-
+    layout registry's state section (node + mailbox + client leaves,
+    alive_prev, group_id — packed per the cfg dials) plus the per-group
+    metric lanes (every active metric leaf except the [H]-row
+    histograms). The one accumulation both byte predicates share —
+    the VMEM and HBM models drifted apart once (alive_prev counted as
+    1 word in one copy); tests pin this form against real kinit
+    leaves, packing off AND on."""
+    words = sum(w for _, w in _wire_state_leaves(cfg))
+    scalar_lanes = len(_active_metric_leaves(cfg)) - _n_row_metrics(cfg)
+    return words + scalar_lanes
+
+
+def _vmem_state_words(cfg: RaftConfig) -> int:
+    """i32 words per group of the UNPACKED in-kernel live form (bools
+    widened, rings full-width — what the fori_loop actually carries in
+    VMEM regardless of the wire dials). Equals the wire accounting with
+    every packing dial off."""
     words = 0
     for _, kind in _node_leaves(cfg):
-        words += cfg.k * {"scalar": 1, "peer": cfg.k,
-                          "ring": cfg.log_cap,
-                          "sess": cfg.client_slots}[kind]
+        words += cfg.k * _kind_words(cfg, kind)
     for f in _mb_fields(cfg):
         words += cfg.k * cfg.k * (cfg.client_slots
                                   if f == "is_req_snap_sessions" else 1)
@@ -165,7 +246,7 @@ def kernel_vmem_bytes(cfg: RaftConfig) -> int:
     fits."""
     # hist rows + the flight-recorder rows (reserved whether or not the
     # caller passes a flight — the predicate must not flip per call).
-    block = (_state_words_per_group(cfg) * 4 * GB
+    block = (_vmem_state_words(cfg) * 4 * GB
              + _n_row_metrics(cfg) * HIST_SIZE * 4 * SUB * LANE
              + len(FLIGHT_LEAVES) * FLIGHT_RING * 4 * SUB * LANE)
     return 5 * block
@@ -173,33 +254,45 @@ def kernel_vmem_bytes(cfg: RaftConfig) -> int:
 
 def wire_words_per_group(cfg: RaftConfig, with_flight: bool = True) -> int:
     """i32 words per group of the kernel wire form: node + mailbox +
-    client-state leaves, alive_prev + group_id, the per-group metric
-    lanes INCLUDING the [H]-row in-kernel histogram(s) (two with
-    clients on: election latency + client ack latency), and (by
-    default — `kinit` reserves the predicate for it whether or not a
-    flight rides) the six flight-recorder ring rows. This is the HBM
+    client-state leaves, alive_prev + group_id (each packed per the cfg
+    layout dials — `_wire_state_leaves`), the per-group metric lanes
+    INCLUDING the [H]-row in-kernel histogram(s) when `cfg.wire_hist`
+    (two with clients on: election latency + client ack latency), and
+    (by default — `kinit` reserves the predicate for it whether or not
+    a flight rides) the six flight-recorder ring rows. This is the HBM
     cost model the mesh-aware `supported()` and
     `scripts/layout_probe.py` share; note the histograms (HIST_SIZE
     words each) and flight rings (6 x RING words) are per-GROUP on the
     wire, unlike the XLA path's global [H] histograms — the biggest
-    non-state contributors to the G ceiling (DESIGN.md §9/§10)."""
+    non-state contributors to the G ceiling (DESIGN.md §9/§10), which
+    is why both are dials now (§13)."""
     words = _state_words_per_group(cfg) + _n_row_metrics(cfg) * HIST_SIZE
     if with_flight:
         words += len(FLIGHT_LEAVES) * FLIGHT_RING
     return words
 
 
+def _residency_buffers(cfg: RaftConfig) -> int:
+    """Live wire copies across a kernel launch: 2 (pallas allocates
+    fresh outputs, so an input AND an output copy of every leaf exist)
+    or 1 under `cfg.alias_wire` (input/output aliasing donates the
+    input buffers — DESIGN.md §13)."""
+    return 1 if cfg.alias_wire else 2
+
+
 def hbm_bytes(cfg: RaftConfig, n_groups: int, n_devices: int = 1,
               with_flight: bool = True) -> int:
     """Peak per-device HBM bytes a sharded kernel run needs: the
     per-device group count padded to a whole block, times the wire
-    words, times 2 — pallas_call allocates fresh output buffers, so an
-    input and an output copy of every leaf are live across a launch
-    (no donation; DESIGN.md §9 names aliasing as the next 2x).
-    `with_flight=False` models a run without the flight-recorder ring
-    (the ring leaves exist on the wire only when kinit gets one)."""
+    words, times the residency multiplier — 2 without donation (an
+    input and an output copy of every leaf are live across a launch),
+    1 under `cfg.alias_wire` (the pallas_call aliases every wire input
+    to its output and the jit donates the operands). `with_flight=
+    False` models a run without the flight-recorder ring (the ring
+    leaves exist on the wire only when kinit gets one)."""
     padded = (-(-n_groups // (n_devices * GB))) * GB
-    return 2 * 4 * wire_words_per_group(cfg, with_flight) * padded
+    return (_residency_buffers(cfg) * 4
+            * wire_words_per_group(cfg, with_flight) * padded)
 
 
 def hbm_ceiling_groups(cfg: RaftConfig, n_devices: int = 1,
@@ -208,10 +301,12 @@ def hbm_ceiling_groups(cfg: RaftConfig, n_devices: int = 1,
     `n_devices`: whole 1024-group blocks only, consistent with
     `hbm_bytes`'s padding — an unpadded HBM / bytes-per-group division
     over-promises by up to a block, and a sweep sized at that figure
-    would be rejected by the very predicate that published it. The
-    single source for every printed/emitted ceiling (layout_probe,
+    would be rejected by the very predicate that published it. Follows
+    every cfg layout dial (packing, aliasing, wire_hist). The single
+    source for every printed/emitted ceiling (layout_probe,
     multichip_sweep)."""
-    per_block = 2 * 4 * wire_words_per_group(cfg, with_flight) * GB
+    per_block = (_residency_buffers(cfg) * 4
+                 * wire_words_per_group(cfg, with_flight) * GB)
     return (HBM_LIMIT_BYTES // per_block) * GB * n_devices
 
 
@@ -1360,29 +1455,36 @@ def _metrics_tick(cfg, m: KMetrics, fl, nodes, mailbox, alive_now, t,
     has_leader = jnp.any((nodes.role == LEADER) & alive_now, axis=0)
     done = has_leader & (m.leaderless > 0)
     safe = _safety_tick(cfg, nodes, cl)
-    hsize = m.hist.shape[0]
-    bucket = jnp.minimum(m.leaderless, hsize - 1)
-    hrow = jax.lax.broadcasted_iota(I32, (hsize, 1, 1), 0)
+    hist = m.hist
+    if hist is not None:   # wire_hist dial off => no rows to maintain
+        hsize = hist.shape[0]
+        bucket = jnp.minimum(m.leaderless, hsize - 1)
+        hrow = jax.lax.broadcasted_iota(I32, (hsize, 1, 1), 0)
+        hist = hist + ((hrow == bucket) & done).astype(I32)
     clm = {}
     if cl is not None:
         # Client SLO lanes (run.metrics_update's client fold): acked /
         # retry totals recomputed from the client state (idempotent),
         # this tick's completion events one-hot-added into the
         # per-group ack-latency rows (a `last_lat` of -1 — no event —
-        # matches no row), and the per-group running max.
+        # matches no row; rows absent under the wire_hist dial), and
+        # the per-group running max.
         acked = retries = None
         for s in range(cfg.client_slots):
             acked = cl.done[s] if acked is None else acked + cl.done[s]
             retries = cl.retries[s] if retries is None \
                 else retries + cl.retries[s]
-        csize = m.client_hist.shape[0]
-        crow = jax.lax.broadcasted_iota(I32, (csize, 1, 1), 0)
         chist = m.client_hist
+        if chist is not None:
+            csize = chist.shape[0]
+            crow = jax.lax.broadcasted_iota(I32, (csize, 1, 1), 0)
         cmax = m.client_max_lat
         for s in range(cfg.client_slots):
             ev = cl.last_lat[s] >= 0
-            chist = chist + ((crow == jnp.minimum(cl.last_lat[s], csize - 1))
-                             & ev).astype(I32)
+            if chist is not None:
+                chist = chist + ((crow == jnp.minimum(cl.last_lat[s],
+                                                      csize - 1))
+                                 & ev).astype(I32)
             cmax = jnp.maximum(cmax, jnp.where(ev, cl.last_lat[s], 0))
         clm = dict(client_acked=acked, client_retries=retries,
                    client_hist=chist, client_max_lat=cmax)
@@ -1393,7 +1495,7 @@ def _metrics_tick(cfg, m: KMetrics, fl, nodes, mailbox, alive_now, t,
         max_latency=jnp.maximum(m.max_latency,
                                 jnp.where(done, m.leaderless, 0)),
         safety=jnp.where(safe, m.safety, 0),
-        hist=m.hist + ((hrow == bucket) & done).astype(I32),
+        hist=hist,
         **clm,
     )
     if fl is None:
@@ -1518,23 +1620,194 @@ def _from_kstate(cfg, flat, g: int) -> State:
                  alive_prev=alive, group_id=gid, clients=clients)
 
 
+# -------------------------------------------------- packed wire layout
+# The pack_bools / pack_ring dials (DESIGN.md §13). Packing happens
+# ONLY at chunk boundaries — host-side in kinit/kfinish and at the
+# kernel's load/store edges — so every per-tick value inside the
+# fori_loop is the identical unpacked form and tick semantics cannot
+# drift with the layout. Both functions run on host ([..., GS, LANE])
+# and in-kernel ([..., 8, 128]) shapes alike: they only touch leading
+# axes with static indices, shifts and masks (Mosaic-safe; no i1
+# constants, no concatenate — stacking is one-hot sums, the histogram
+# row pattern).
+
+
+def _mb_bool_fields(cfg):
+    """Bool mailbox leaves present under `cfg`, in Mailbox field
+    order — the shared-lane set of the pack_bools dial (bit index =
+    field-position x k + src)."""
+    return [f for f in _mb_fields(cfg) if f in _MB_BOOL]
+
+
+def _unpacked_names(cfg):
+    """Wire-leaf names of the UNPACKED state section, in r12 registry
+    order — the list `_to_kstate` emits and the kernel body consumes."""
+    return ([f for f, _ in _node_leaves(cfg)] + list(_mb_fields(cfg))
+            + (list(CLIENT_LEAVES) if cfg.clients_u32 else [])
+            + ["alive_prev", "group_id"])
+
+
+def _stack0(rows):
+    """Stack equal-shape arrays along a NEW leading axis via one-hot
+    sums (no concatenate — Mosaic lowering)."""
+    io = jax.lax.broadcasted_iota(I32, (len(rows),) + (1,) * rows[0].ndim,
+                                  0)
+    acc = None
+    for j, r in enumerate(rows):
+        t = jnp.where(io == j, r[None], 0)
+        acc = t if acc is None else acc + t
+    return acc
+
+
+def _stack1(rows):
+    """Stack [K, ...] arrays along a NEW axis 1 -> [K, n, ...]."""
+    io = jax.lax.broadcasted_iota(
+        I32, (1, len(rows)) + (1,) * (rows[0].ndim - 1), 1)
+    acc = None
+    for j, r in enumerate(rows):
+        t = jnp.where(io == j, r[:, None], 0)
+        acc = t if acc is None else acc + t
+    return acc
+
+
+def _ring_base_ov(cfg, log_term):
+    """(base, overflow) of the ring-delta encoding: per-group min term
+    over the [K, L] window, and 1 where any delta exceeds the 16-bit
+    half-lane (the encode would wrap — kfinish refuses on the flag,
+    never returns silently wrong terms)."""
+    base = jnp.min(jnp.min(log_term, axis=0), axis=0)
+    spread = jnp.max(jnp.max(log_term, axis=0), axis=0) - base
+    return base, (spread > 0xFFFF).astype(I32)
+
+
+def _pack_wire(cfg, flat, aux=None):
+    """Unpacked wire list (bools widened to i32, `_unpacked_names`
+    order) -> packed wire list (`_wire_state_leaves` order). Identity
+    when every packing dial is off. `aux` is the dict the matching
+    `_unpack_wire` returned — it carries the sticky ring-overflow bit
+    so a chunk that decoded an already-overflowed wire re-encodes the
+    flag (None = fresh encode, i.e. kinit)."""
+    if not (cfg.pack_bools or cfg.pack_ring):
+        return list(flat)
+    d = dict(zip(_unpacked_names(cfg), flat))
+    mbb = _mb_bool_fields(cfg) if cfg.pack_bools else []
+    ring = _ring_base_ov(cfg, d["log_term"]) if cfg.pack_ring else None
+    out = []
+    for name, _ in _wire_state_leaves(cfg):
+        if cfg.pack_bools and name == "votes":
+            v = d["votes"]
+            acc = v[:, 0] & 1
+            for p in range(1, cfg.k):
+                acc = acc | ((v[:, p] & 1) << p)
+            out.append(acc)
+        elif name == MB_BOOLS_PACKED:
+            n_words = -(-len(mbb) * cfg.k // 32)
+            words = [None] * n_words
+            for fi, f in enumerate(mbb):
+                leaf = d[f]
+                for s in range(cfg.k):
+                    b = fi * cfg.k + s
+                    t = (leaf[:, s] & 1) << (b % 32)
+                    words[b // 32] = t if words[b // 32] is None \
+                        else words[b // 32] | t
+            out.append(_stack1(words))
+        elif cfg.pack_bools and name == "alive_prev":
+            a = d["alive_prev"]
+            acc = a[0] & 1
+            for j in range(1, cfg.k):
+                acc = acc | ((a[j] & 1) << j)
+            out.append(acc)
+        elif cfg.pack_ring and name == "log_term":
+            base = ring[0]
+            delta = d["log_term"] - base[None, None]
+            out.append(_stack1(
+                [(delta[:, 2 * j] & 0xFFFF)
+                 | ((delta[:, 2 * j + 1] & 0xFFFF) << 16)
+                 for j in range(cfg.log_cap // 2)]))
+        elif name == RING_BASE:
+            base, ov = ring
+            if aux is not None and "ring_ov" in aux:
+                ov = ov | aux["ring_ov"]
+            out.append(base | (ov << 31))
+        else:
+            out.append(d[name])
+    return out
+
+
+def _unpack_wire(cfg, flat):
+    """Packed wire list -> (unpacked list in `_unpacked_names` order,
+    aux). Exact inverse of `_pack_wire` for every in-range encoding;
+    `aux["ring_ov"]` carries the sticky overflow bit back to the next
+    pack (kfinish checks it host-side and raises)."""
+    if not (cfg.pack_bools or cfg.pack_ring):
+        return list(flat), {}
+    d = dict(zip([n for n, _ in _wire_state_leaves(cfg)], flat))
+    out, aux = {}, {}
+    if cfg.pack_bools:
+        pv = d["votes"]
+        out["votes"] = _stack1([(pv >> q) & 1 for q in range(cfg.k)])
+        pm = d[MB_BOOLS_PACKED]
+        for fi, f in enumerate(_mb_bool_fields(cfg)):
+            rows = []
+            for s in range(cfg.k):
+                b = fi * cfg.k + s
+                rows.append((pm[:, b // 32] >> (b % 32)) & 1)
+            out[f] = _stack1(rows)
+        pa = d["alive_prev"]
+        out["alive_prev"] = _stack0([(pa >> j) & 1 for j in range(cfg.k)])
+    if cfg.pack_ring:
+        bl = d[RING_BASE]
+        aux["ring_ov"] = (bl >> 31) & 1
+        base = bl & 0x7FFFFFFF
+        pk = d["log_term"]
+        out["log_term"] = _stack1(
+            [base[None] + ((pk[:, sl // 2] >> (16 * (sl % 2))) & 0xFFFF)
+             for sl in range(cfg.log_cap)])
+    return [out[n] if n in out else d[n] for n in _unpacked_names(cfg)], aux
+
+
+def _check_ring_overflow(cfg, leaves, g: int):
+    """Host-side refusal on the sticky delta-overflow flag: a >=2^16
+    in-group term spread cannot be 16-bit delta-encoded, and silently
+    wrong terms must never leave kfinish. Re-run with pack_ring off
+    (the universe itself is fine — only the wire encoding saturated)."""
+    if not cfg.pack_ring:
+        return
+    import numpy as np
+    base = np.asarray(_unfold_g(leaves[_wire_index(cfg, RING_BASE)]))[:g]
+    if (base < 0).any():   # bit 31 = the sticky overflow flag
+        raise ValueError(
+            f"pack_ring: ring-term delta overflowed the 16-bit half-lane "
+            f"in {int((base < 0).sum())} group(s) (in-group term spread "
+            f">= 2^16) — state cannot be decoded; re-run with "
+            f"pack_ring=False")
+
+
 def _build_kernel(cfg, n_ticks, with_flight):
     """The pallas kernel body: load block -> fori_loop of ticks -> store.
     `with_flight` (static) adds the six flight-recorder ring leaves
     between the group ids and the metric tail (wire order)."""
     node_kinds = _node_leaves(cfg)
     mb_fields = _mb_fields(cfg)
-    n_in = (_n_state_leaves(cfg)
+    n_state = _n_state_leaves(cfg)
+    n_in = (n_state
             + (len(FLIGHT_LEAVES) if with_flight else 0)
             + _n_metric_leaves(cfg))
 
     def kernel(t0_ref, *refs):
         in_refs = refs[:n_in]
         out_refs = refs[n_in:]
-        it = iter(in_refs)
+        # Chunk-boundary DECODE (DESIGN.md §13): the packed wire leaves
+        # expand to the r12 unpacked form once per launch; everything
+        # below — the fori_loop included — sees identical values
+        # whatever the layout dials say. `aux` carries the sticky
+        # ring-overflow bit through to the re-encode.
+        state_flat, aux = _unpack_wire(cfg, [r[:] for r in
+                                             in_refs[:n_state]])
+        it = iter(state_flat)
         nd = {}
         for f, kind in node_kinds:
-            a = next(it)[:]
+            a = next(it)
             if f == "votes":
                 a = a != 0
             elif f in ("snap_digest", "digest"):
@@ -1542,7 +1815,7 @@ def _build_kernel(cfg, n_ticks, with_flight):
             nd[f] = a
         md = {}
         for f in mb_fields:
-            a = next(it)[:]
+            a = next(it)
             if f in _MB_BOOL:
                 a = a != 0
             elif f == "is_req_snap_digest":
@@ -1550,13 +1823,14 @@ def _build_kernel(cfg, n_ticks, with_flight):
             md[f] = a
         cl = None
         if cfg.clients_u32:
-            cl = ClientState(**{f: next(it)[:] for f in CLIENT_LEAVES})
-        alive_prev = next(it)[:] != 0
-        g = next(it)[:]
+            cl = ClientState(**{f: next(it) for f in CLIENT_LEAVES})
+        alive_prev = next(it) != 0
+        g = next(it)
+        tail = iter(in_refs[n_state:])
         fl = None
         if with_flight:
-            fl = Flight(**{f: next(it)[:] for f in FLIGHT_LEAVES})
-        met = KMetrics(**{f: next(it)[:]
+            fl = Flight(**{f: next(tail)[:] for f in FLIGHT_LEAVES})
+        met = KMetrics(**{f: next(tail)[:]
                           for f in _active_metric_leaves(cfg)})
         nodes = PerNode(**nd)
         mailbox = Mailbox(**md)
@@ -1592,20 +1866,24 @@ def _build_kernel(cfg, n_ticks, with_flight):
             (widen((nodes, mailbox, alive_prev, cl)), met, fl))
         nodes, mailbox, alive_prev, cl = narrow_like(state_i, proto)
 
-        ot = iter(out_refs)
+        # Chunk-boundary ENCODE: widen to the i32 unpacked list, pack
+        # per the layout dials, write the wire.
+        outs = []
         for f, _ in node_kinds:
             a = getattr(nodes, f)
-            next(ot)[:] = a.astype(I32) \
-                if a.dtype in (jnp.bool_, jnp.uint32) else a
+            outs.append(a.astype(I32)
+                        if a.dtype in (jnp.bool_, jnp.uint32) else a)
         for f in mb_fields:
             a = getattr(mailbox, f)
-            next(ot)[:] = a.astype(I32) \
-                if a.dtype in (jnp.bool_, jnp.uint32) else a
+            outs.append(a.astype(I32)
+                        if a.dtype in (jnp.bool_, jnp.uint32) else a)
         if cfg.clients_u32:
-            for f in CLIENT_LEAVES:
-                next(ot)[:] = getattr(cl, f)
-        next(ot)[:] = alive_prev.astype(I32)
-        next(ot)[:] = g
+            outs.extend(getattr(cl, f) for f in CLIENT_LEAVES)
+        outs.append(alive_prev.astype(I32))
+        outs.append(g)
+        ot = iter(out_refs)
+        for a in _pack_wire(cfg, outs, aux):
+            next(ot)[:] = a
         if with_flight:
             for f in FLIGHT_LEAVES:
                 next(ot)[:] = getattr(fl, f)
@@ -1626,8 +1904,7 @@ def _gspec(a):
     return pl.BlockSpec(lead + (SUB, LANE), imap)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "n_ticks", "interpret"))
-def _prun_padded(cfg, leaves, t0, n_ticks, interpret=False):
+def _prun_padded_impl(cfg, leaves, t0, n_ticks, interpret=False):
     with_flight = len(leaves) > _n_state_leaves(cfg) + _n_metric_leaves(cfg)
     kernel = _build_kernel(cfg, n_ticks, with_flight)
     nb = leaves[0].shape[-2] // SUB
@@ -1636,16 +1913,41 @@ def _prun_padded(cfg, leaves, t0, n_ticks, interpret=False):
     out_shape = [jax.ShapeDtypeStruct(a.shape, I32) for a in leaves]
     out_specs = [_gspec(a) for a in leaves]
     t0a = jnp.asarray([t0], I32)
+    # Input/output aliasing (DESIGN.md §13): every wire input donates
+    # its HBM buffer to the same-shaped output (operand i+1 -> result
+    # i; operand 0 is the SMEM t0). Safe because the grid visits each
+    # block exactly once and fully overwrites it. Compiled path only —
+    # the interpret path runs as plain XLA where aliasing buys nothing
+    # and some jaxlib versions reject the kwarg-in-interpreter combo.
+    ioa = {}
+    if cfg.alias_wire and not interpret:
+        ioa = {i + 1: i for i in range(len(leaves))}
     return pl.pallas_call(
         kernel,
         grid=(nb,),
         in_specs=in_specs,
         out_shape=out_shape,
         out_specs=out_specs,
+        input_output_aliases=ioa,
         interpret=interpret,
         compiler_params=None if interpret else pltpu.CompilerParams(
             vmem_limit_bytes=VMEM_LIMIT_BYTES),
     )(t0a, *leaves)
+
+
+_prun_padded = jax.jit(_prun_padded_impl,
+                       static_argnames=("cfg", "n_ticks", "interpret"))
+# The donating twin `kstep` dispatches to under cfg.alias_wire: the
+# wire operands' buffers are released to the launch, so ONE wire copy
+# is resident instead of in+out — the other half of the §13 aliasing
+# lever (pallas aliases the custom call; jit donation lets XLA actually
+# reuse the operand buffers). Callers must treat passed-in leaves as
+# consumed, which every chunk loop in the repo already does
+# (`leaves = kstep(leaves, ...)`).
+_prun_padded_donate = jax.jit(_prun_padded_impl,
+                              static_argnames=("cfg", "n_ticks",
+                                               "interpret"),
+                              donate_argnums=(1,))
 
 
 def kinit(cfg: RaftConfig, st: State, metrics: Metrics | None = None,
@@ -1710,23 +2012,32 @@ def kinit(cfg: RaftConfig, st: State, metrics: Metrics | None = None,
     mvals = {"committed": lane(metrics.committed),
              "leaderless": lane(metrics.leaderless),
              "elections": lane(None), "max_latency": lane(None),
-             "safety": lane(metrics.safety, fill=1),
-             "hist": rows()}
+             "safety": lane(metrics.safety, fill=1)}
+    if cfg.wire_hist:
+        # The §13 telemetry dial: with wire_hist off the [H]-row leaves
+        # never ride the wire (and the kernel tracks no histograms).
+        mvals["hist"] = rows()
     if cfg.clients_u32:
         mvals.update(client_acked=lane(metrics.client_acked),
                      client_retries=lane(metrics.client_retries),
-                     client_max_lat=lane(None), client_hist=rows())
+                     client_max_lat=lane(None))
+        if cfg.wire_hist:
+            mvals["client_hist"] = rows()
     mleaves = [mvals[n] for n in _active_metric_leaves(cfg)]
-    return tuple(leaves + fleaves + mleaves), g
+    return tuple(_pack_wire(cfg, leaves) + fleaves + mleaves), g
 
 
 def kstep(cfg: RaftConfig, leaves, t0: int, n_ticks: int,
           interpret: bool = False):
     """One kernel launch: `n_ticks` ticks starting at absolute tick
     `t0` (traced — chunked calls at advancing t0 reuse one compile).
-    Returns the evolved leaves tuple."""
-    return tuple(_prun_padded(cfg, tuple(leaves), int(t0), int(n_ticks),
-                              interpret=interpret))
+    Returns the evolved leaves tuple. Under `cfg.alias_wire` (compiled
+    path) the input leaves' buffers are DONATED to the launch — stale
+    after the call, exactly like the chunk loops already use them."""
+    fn = _prun_padded_donate if (cfg.alias_wire and not interpret) \
+        else _prun_padded
+    return tuple(fn(cfg, tuple(leaves), int(t0), int(n_ticks),
+                    interpret=interpret))
 
 
 METRIC_LEAVES = ("committed", "leaderless", "elections", "max_latency",
@@ -1744,10 +2055,15 @@ N_METRIC_LEAVES = len(METRIC_LEAVES)
 
 def _active_metric_leaves(cfg) -> tuple:
     """The metric leaves actually on the wire under `cfg`, in
-    METRIC_LEAVES order."""
-    if cfg.clients_u32:
-        return METRIC_LEAVES
-    return tuple(n for n in METRIC_LEAVES if n not in CLIENT_METRIC_LEAVES)
+    METRIC_LEAVES order: client lanes ride only with clients on, the
+    [H]-row histogram leaves only under the `wire_hist` telemetry dial
+    (DESIGN.md §13 — with it off the kernel tracks no latency
+    histograms and kfinish passes the caller's rows through)."""
+    names = METRIC_LEAVES if cfg.clients_u32 else tuple(
+        n for n in METRIC_LEAVES if n not in CLIENT_METRIC_LEAVES)
+    if not cfg.wire_hist:
+        names = tuple(n for n in names if n not in ROW_METRIC_LEAVES)
+    return names
 
 
 def _n_metric_leaves(cfg) -> int:
@@ -1762,11 +2078,11 @@ def _n_row_metrics(cfg) -> int:
 
 
 def _n_state_leaves(cfg) -> int:
-    """Wire leaves ahead of the (flight, metrics) tail: node + mailbox
-    leaves + the client-state leaves (clients on) + alive_prev +
-    group_id."""
-    return (len(_node_leaves(cfg)) + len(_mb_fields(cfg)) + 2
-            + (len(CLIENT_LEAVES) if cfg.clients_u32 else 0))
+    """Wire leaves ahead of the (flight, metrics) tail — the packed-
+    layout registry's length (node + mailbox leaves packed per the cfg
+    dials + the client-state leaves with clients on + alive_prev +
+    group_id)."""
+    return len(_wire_state_leaves(cfg))
 
 
 def _mleaf(cfg, leaves, name: str):
@@ -1788,10 +2104,13 @@ def kcommitted(cfg, leaves, g: int) -> int:
 
 def kreads(cfg, leaves, g: int) -> int:
     """Host-side total completed scheduled reads (sum of the per-node
-    `reads_done` counters), straight from the wire form."""
+    `reads_done` counters), straight from the wire form — indexed by
+    NAME through the packed-layout registry (the packing dials insert/
+    remove wire leaves, so positional constants would silently read a
+    neighbor)."""
     import numpy as np
-    idx = [f for f, _ in _node_leaves(cfg)].index("reads_done")
-    rd = np.asarray(_unfold_g(leaves[idx]))[..., :g]   # [K, g]
+    rd = np.asarray(_unfold_g(
+        leaves[_wire_index(cfg, "reads_done")]))[..., :g]   # [K, g]
     return int(rd.astype(np.int64).sum())
 
 
@@ -1861,11 +2180,21 @@ def kfinish(cfg: RaftConfig, leaves, g: int,
     if metrics_base is None:
         metrics_base = metrics_init(g, clients=clients_on)
     n_state = _n_state_leaves(cfg)
-    st = _from_kstate(cfg, [_unfold_g(a) for a in leaves[:n_state]], g)
+    # Refuse on the sticky ring-overflow flag BEFORE decoding: a
+    # saturated delta encode cannot be inverted.
+    _check_ring_overflow(cfg, leaves, g)
+    flat, _ = _unpack_wire(cfg, list(leaves[:n_state]))
+    st = _from_kstate(cfg, [_unfold_g(a) for a in flat], g)
     mc, ml, me, mx, ms = [
         _unfold_g(_mleaf(cfg, leaves, n))[:g]
         for n in ("committed", "leaderless", "elections", "max_latency",
                   "safety")]
+    # Under the wire_hist dial the kernel tracked no histogram rows:
+    # the caller's base rows pass through unchanged (telemetry simply
+    # stops accumulating — the dial's documented contract).
+    hist = metrics_base.hist
+    if cfg.wire_hist:
+        hist = hist + khist(cfg, leaves, g)
     cl = {}
     if clients_on:
         # Pass-through lanes read back; the accumulate-from-zero rows /
@@ -1880,15 +2209,17 @@ def kfinish(cfg: RaftConfig, leaves, g: int,
         base_m = (metrics_base.client_max_lat
                   if metrics_base.client_max_lat is not None
                   else jnp.zeros((), I32))
+        chist = base_h
+        if cfg.wire_hist:
+            chist = chist + khist(cfg, leaves, g, name="client_hist")
         cl = dict(client_acked=ca, client_retries=cr,
-                  client_hist=base_h + khist(cfg, leaves, g,
-                                             name="client_hist"),
+                  client_hist=chist,
                   client_max_lat=jnp.maximum(jnp.asarray(base_m, I32),
                                              jnp.max(cm)))
     met = Metrics(
         committed=mc, leaderless=ml,
         elections=metrics_base.elections + jnp.sum(me),
-        hist=metrics_base.hist + khist(cfg, leaves, g),
+        hist=hist,
         max_latency=jnp.maximum(metrics_base.max_latency, jnp.max(mx)),
         safety=ms,
         **cl,

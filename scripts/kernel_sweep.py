@@ -73,20 +73,28 @@ def _check_pairwise(rows):
                 f"{FACTORS[j]} only hit {sorted(seen)}")
 
 
-def sweep_configs(base_seed: int, clients: bool = False):
+def sweep_configs(base_seed: int, clients: bool = False,
+                  packed: bool = False):
     """The 6 sweep universes: k in {3,4,5} and L in {16,32} cycle
     across the covering-array rows, seeds derived from base_seed. With
     `clients` (the `--clients` axis, ISSUE r09) every universe swaps
     the scheduled fire-hose for open-loop exactly-once session traffic
     (sessions=True, cmds_per_tick=0, retrying clients) — the same
     pairwise feature x fault matrix, driven by duplicate-risk client
-    ops through BOTH engines."""
+    ops through BOTH engines. With `packed` (the `--packed` axis,
+    ISSUE r13) every universe runs the kernel on the packed + donated
+    wire (pack_bools + pack_ring + alias_wire) — packing is a
+    chunk-boundary re-encode, so the full State + Metrics bit-identity
+    gate applies UNCHANGED, and the matrix becomes packed x features x
+    faults pairwise evidence."""
     ks = (3, 4, 5)
     ls = (16, 32)
     cl = {}
     if clients:
         cl = dict(sessions=True, cmds_per_tick=0, client_rate=0.25,
                   client_slots=3, client_retry_backoff=6)
+    if packed:
+        cl.update(pack_bools=True, pack_ring=True, alias_wire=True)
     for n, row in enumerate(ROWS):
         prevote, reconfig, transfer, reads, partition = row
         yield RaftConfig(
@@ -180,6 +188,11 @@ def main():
                     "exactly-once session traffic instead of the "
                     "scheduled fire-hose (sessions x fault matrix; "
                     "exit nonzero on divergence or double-apply)")
+    ap.add_argument("--packed", action="store_true",
+                    help="run every universe's kernel on the r13 "
+                    "packed + donated wire (pack_bools + pack_ring + "
+                    "alias_wire) — packed x feature x fault pairwise "
+                    "cells, same full State+Metrics bit-identity gate")
     args = ap.parse_args()
     _check_pairwise(ROWS)
 
@@ -220,11 +233,14 @@ def main():
         return 2
 
     failures = violations = swept = 0
-    for n, cfg in enumerate(sweep_configs(args.seed, args.clients)):
+    for n, cfg in enumerate(sweep_configs(args.seed, args.clients,
+                                          args.packed)):
         feats = "+".join(f for f, on in zip(FACTORS, ROWS[n]) if on) \
             or "faults-only"
         if args.clients:
             feats += "+clients"
+        if args.packed:
+            feats += "+packed"
         # Sweep universes carry no flight ring: budget the flight-off
         # model, matching run_universe's flightless prun/prun_sharded.
         if not pkernel.supported(cfg, args.groups, args.devices,

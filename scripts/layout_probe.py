@@ -165,12 +165,115 @@ def bytes_per_group_report(cfg=None):
         print(f"derived wire model [{label}]: "
               f"{model['wire_bytes_derived']} B/group ({verdict})")
     w = bytemodel.derived_wire_model(cfg)["widening"]
-    print(f"i32-widened bool leaves ({len(w['leaves'])}, structural — "
-          f"Mosaic transports no i1 vectors): "
-          f"{w['waste_bytes_per_group']} B/group of widening waste "
-          f"(wire {w['wire_bytes']} B vs {w['native_bytes']} B if i8):")
+    print(f"i32-widened bool leaves ({len(w['leaves'])} — Mosaic "
+          f"transports no i1 vectors, so each bool word burns 3 wire "
+          f"bytes UNLESS the pack_bools dial bit-packs it, DESIGN.md "
+          f"§13): {w['waste_bytes_per_group']} B/group of widening "
+          f"waste (wire {w['wire_bytes']} B vs {w['native_bytes']} B "
+          f"if i8):")
     for name in w["leaves"]:
         print(f"    {name}")
+    import dataclasses as _dc
+    pcfg = _dc.replace(cfg, pack_bools=True, pack_ring=True)
+    pm = bytemodel.derived_wire_model(pcfg)
+    pverdict = "derived == pinned" if not pm["problems"] else \
+        "; ".join(pm["problems"])
+    print(f"derived wire model [packed, bools+ring]: "
+          f"{pm['wire_bytes_derived']} B/group ({pverdict}); run "
+          f"--ablate for the full per-encoding table + ceilings")
+
+
+# The r13 encoding ablation (DESIGN.md §13): one row per layout-dial
+# combination, additive order — each row's delta against the previous
+# is that encoding's price. (label, knob dict, with_flight).
+ABLATION_ROWS = (
+    ("baseline (r12 wire)", {}, True),
+    ("+pack_bools", dict(pack_bools=True), True),
+    ("+pack_ring", dict(pack_bools=True, pack_ring=True), True),
+    ("+alias_wire", dict(pack_bools=True, pack_ring=True,
+                         alias_wire=True), True),
+    ("+wire_hist off", dict(pack_bools=True, pack_ring=True,
+                            alias_wire=True, wire_hist=False), True),
+    ("+flight off (all dials)", dict(pack_bools=True, pack_ring=True,
+                                     alias_wire=True, wire_hist=False),
+     False),
+)
+
+
+def _measure_ticks_per_sec(cfg, n_groups: int, ticks: int,
+                           with_flight: bool):
+    """Steady-state kernel ticks/s at one layout (bench warmup
+    protocol: 2 compile-absorbing chunks, timed chunks closed by the
+    counter fetch). TPU only — the caller gates."""
+    from raft_tpu import sim
+    from raft_tpu.obs import flight_init
+    from raft_tpu.sim import pkernel
+
+    chunk = 200
+    fl = flight_init(n_groups) if with_flight else None
+    leaves, g = pkernel.kinit(cfg, sim.init(cfg, n_groups=n_groups),
+                              flight=fl)
+    leaves = pkernel.kstep(cfg, leaves, 0, chunk)
+    pkernel.kcommitted(cfg, leaves, g)
+    leaves = pkernel.kstep(cfg, leaves, chunk, chunk)
+    pkernel.kcommitted(cfg, leaves, g)
+    n_chunks = max(1, ticks // chunk)
+    t0 = time.perf_counter()
+    for c in range(n_chunks):
+        leaves = pkernel.kstep(cfg, leaves, (c + 2) * chunk, chunk)
+    pkernel.kcommitted(cfg, leaves, g)   # fetch closes the timer
+    return n_chunks * chunk / (time.perf_counter() - t0)
+
+
+def ablation_table(measure: bool, groups: int, ticks: int):
+    """The per-encoding toggle table (ISSUE r13 satellite, recorded in
+    DESIGN.md §13): wire B/group, modeled single-chip ceiling (the
+    exact supported() boundary, residency multiplier included), and —
+    where a TPU is attached — measured steady-state ticks/s per row so
+    any encoding that does not pay is caught here and reverted to
+    default-off."""
+    import dataclasses
+
+    import jax
+
+    from raft_tpu.config import RaftConfig
+    from raft_tpu.sim import pkernel
+
+    base = RaftConfig(seed=42)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if measure and not on_tpu:
+        print("(no TPU attached: measured column is modeled-only — "
+              "the driver's --ablate run fills it)")
+    print(f"encoding ablation, headline config (k={base.k}, "
+          f"L={base.log_cap}; HBM {pkernel.HBM_LIMIT_BYTES >> 30} GiB):")
+    print(f"  {'encoding':28s} {'B/group':>8s} {'x res':>5s} "
+          f"{'ceiling groups':>14s} {'measured ticks/s':>16s}")
+    prev_ceiling = None
+    for label, knobs, with_flight in ABLATION_ROWS:
+        cfg = dataclasses.replace(base, **knobs)
+        wire = 4 * pkernel.wire_words_per_group(cfg,
+                                                with_flight=with_flight)
+        ceiling = pkernel.hbm_ceiling_groups(cfg, with_flight=with_flight)
+        measured = "-"
+        if measure and on_tpu:
+            try:
+                tps = _measure_ticks_per_sec(cfg, groups, ticks,
+                                             with_flight)
+                measured = f"{tps:,.1f}"
+            except Exception as e:   # a row must never kill the table
+                measured = f"error: {type(e).__name__}"
+        gain = ""
+        if prev_ceiling:
+            gain = f"  ({ceiling / prev_ceiling:.2f}x)"
+        print(f"  {label:28s} {wire:8,d} "
+              f"{pkernel._residency_buffers(cfg):>5d} "
+              f"{ceiling:>14,d} {measured:>16s}{gain}")
+        prev_ceiling = ceiling
+    all_cfg = dataclasses.replace(base, **ABLATION_ROWS[-1][1])
+    r12 = pkernel.hbm_ceiling_groups(base)
+    full = pkernel.hbm_ceiling_groups(all_cfg, with_flight=False)
+    print(f"  all dials vs r12 baseline: {full:,d} / {r12:,d} groups = "
+          f"{full / r12:.2f}x the modeled single-chip ceiling")
 
 
 def main():
@@ -178,7 +281,18 @@ def main():
     ap.add_argument("--bytes-only", action="store_true",
                     help="print the bytes/group + G-ceiling report and "
                     "exit (no timing probe)")
+    ap.add_argument("--ablate", action="store_true",
+                    help="print the r13 encoding-ablation table "
+                    "(DESIGN.md §13): per-dial wire bytes + modeled "
+                    "ceiling + measured ticks/s on a TPU; exit")
+    ap.add_argument("--ablate-groups", type=int, default=100_000,
+                    help="group count for the measured ablation column")
+    ap.add_argument("--ablate-ticks", type=int, default=600,
+                    help="timed ticks for the measured ablation column")
     args = ap.parse_args()
+    if args.ablate:
+        ablation_table(True, args.ablate_groups, args.ablate_ticks)
+        return
     bytes_per_group_report()
     if args.bytes_only:
         return

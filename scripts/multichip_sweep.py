@@ -54,8 +54,12 @@ def log(msg):
 
 
 def _predicted(cfg):
+    import dataclasses
+
+    from raft_tpu.config import LAYOUT_FIELDS
     from raft_tpu.sim import pkernel
-    return {
+    buffers = pkernel._residency_buffers(cfg)
+    out = {
         "wire_bytes_per_group":
             4 * pkernel.wire_words_per_group(cfg, with_flight=True),
         "wire_bytes_per_group_no_flight":
@@ -68,10 +72,35 @@ def _predicted(cfg):
         "single_chip_ceiling_groups": pkernel.hbm_ceiling_groups(cfg),
         "single_chip_ceiling_groups_no_flight":
             pkernel.hbm_ceiling_groups(cfg, with_flight=False),
-        "model": "2x (in + out buffers, no donation) x padded groups; "
-                 "see scripts/layout_probe.py --bytes-only for the "
-                 "per-leaf breakdown",
+        # r13 layout provenance: the dials this sweep's cfg ran with,
+        # and the ceiling every dial at once would model (the
+        # layout_probe --ablate headline).
+        "layout": {k: getattr(cfg, k) for k in LAYOUT_FIELDS},
+        "residency_buffers": buffers,
+        "single_chip_ceiling_groups_all_dials":
+            pkernel.hbm_ceiling_groups(dataclasses.replace(
+                cfg, pack_bools=True, pack_ring=True, alias_wire=True,
+                wire_hist=False), with_flight=False),
+        "model": f"{buffers}x resident wire copies "
+                 f"({'donated' if buffers == 1 else 'in + out buffers'}) "
+                 "x padded groups; see scripts/layout_probe.py "
+                 "--ablate for the per-encoding breakdown",
     }
+    return out
+
+
+def _hist_comparable(cfg, m_ref, m_ker):
+    """Under the wire_hist dial the kernel tracks no histogram rows
+    (its Metrics pass the caller's base through), so the [H]-row leaves
+    are not a differential surface: substitute the kernel's rows into
+    the reference copy so trees_equal_why still covers every OTHER
+    metric leaf bit-for-bit. Identity when the dial is on."""
+    if cfg.wire_hist:
+        return m_ref
+    sub = {"hist": m_ker.hist}
+    if m_ref.client_hist is not None:
+        sub["client_hist"] = m_ker.client_hist
+    return m_ref._replace(**sub)
 
 
 def _gate(cfg, n_groups, ticks, mesh, interpret):
@@ -122,6 +151,7 @@ def _gate(cfg, n_groups, ticks, mesh, interpret):
             verdicts["vs_kernel_1dev"] = f"error: {type(e).__name__}"
     try:
         st_x, m_x = run(cfg, st0, ticks)
+        m_x = _hist_comparable(cfg, m_x, m_sh)
         ok_s, why_s = trees_equal_why(st_x, st_sh)
         ok_m, why_m = trees_equal_why(
             m_x, m_sh, names=list(type(m_x)._fields))
@@ -262,13 +292,20 @@ def dryrun_cell(n_groups, n_devices, dry_ticks):
     return cell
 
 
-def interpret_gate(n_devices: int):
+def interpret_gate(n_devices: int, dials: dict | None = None):
     """The sharded-KERNEL differential a CPU box can afford: interpret
     mode at the tests/test_kmesh.py shape (warm compile cache), 3-way
-    vs the unsharded kernel and the XLA path."""
+    vs the unsharded kernel and the XLA path. `dials` (r13 layout
+    knobs) re-runs it at the requested packed layout — a fresh
+    interpret compile, but the only sharded-kernel evidence a --pack
+    sweep can produce off-TPU."""
+    import dataclasses
+
     from raft_tpu import parallel
 
     cfg = _dry_cfg()
+    if dials:
+        cfg = dataclasses.replace(cfg, **dials)
     mesh = parallel.make_mesh(n_devices)
     t0 = time.perf_counter()
     verdicts, unsafe, _ = _gate(cfg, cfg.n_groups, 48, mesh,
@@ -290,6 +327,19 @@ def main():
                     help="TPU smoke: one small G, 200 timed ticks")
     ap.add_argument("--dry-ticks", type=int, default=48,
                     help="ticks for the scaled CPU dryrun cells")
+    # r13 wire-layout dials (DESIGN.md §13): the G x D grid probed at a
+    # packed/donated/telemetry-dialed layout — the whole point of the
+    # dials is moving the very ceiling this sweep exists to measure.
+    ap.add_argument("--pack", action="store_true",
+                    help="pack the kernel wire (pack_bools + pack_ring)")
+    ap.add_argument("--alias", action="store_true",
+                    help="input/output-alias + donate the wire buffers "
+                         "(halves the residency model)")
+    ap.add_argument("--no-hist", action="store_true",
+                    help="drop the in-kernel [H]-row histograms from "
+                         "the wire (ceiling-run telemetry dial; the "
+                         "state gate still runs bit-exact, histogram "
+                         "rows are excluded from the differential)")
     args = ap.parse_args()
 
     max_d = max(D_LIST)
@@ -307,7 +357,10 @@ def main():
 
     from raft_tpu.config import RaftConfig
 
-    cfg = RaftConfig(seed=42)   # the config-5 headline universe
+    cfg = RaftConfig(seed=42,   # the config-5 headline universe
+                     pack_bools=args.pack, pack_ring=args.pack,
+                     alias_wire=args.alias,
+                     wire_hist=not args.no_hist)
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     n_avail = len(jax.devices())
@@ -345,11 +398,16 @@ def main():
                             f"{dry_by_d[n_devices]['status']}")
                 grid.append({**dry_by_d[n_devices], "groups": n_groups})
 
+    from raft_tpu.config import LAYOUT_FIELDS
+    defaults = RaftConfig(seed=42)
+    dials = {k: getattr(cfg, k) for k in LAYOUT_FIELDS}
+    dialed = any(dials[k] != getattr(defaults, k) for k in LAYOUT_FIELDS)
     gate = None
     if not on_tpu:
-        log("interpret-mode sharded-kernel gate (8 devices, 64 groups):")
+        log(f"interpret-mode sharded-kernel gate (8 devices, 64 groups"
+            f"{', dialed layout' if dialed else ''}):")
         try:
-            gate = interpret_gate(max_d)
+            gate = interpret_gate(max_d, dials if dialed else None)
             log(f"  state_identical={gate['state_identical']} "
                 f"safety_ok={gate['safety_ok']} ({gate['wall_s']}s)")
         except Exception as e:

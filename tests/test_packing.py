@@ -1,0 +1,377 @@
+"""The r13 packed-wire layer (DESIGN.md §13): bit-packed bools, delta-
+encoded ring terms, input/output aliasing, and the telemetry dials.
+
+The contract under test: every layout dial is WIRE-ONLY. Packing and
+unpacking happen at chunk boundaries, so a packed kernel must stay
+bit-identical to the XLA path on the full State pytree and (histogram
+dial aside) the full Metrics pytree; the modeled single-chip ceiling
+must re-derive through all three byte accountings at the packed sizes
+(with the 8,308 / 11,056 B/group r12 baselines preserved as the
+off-path pins); and checkpoints must be layout-blind in both
+directions (a packed run resumes a pre-r13 file and vice versa).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+
+import numpy as np
+import pytest
+
+import conftest  # noqa: F401  (pins the CPU platform before jax loads)
+import jax.numpy as jnp
+
+from raft_tpu.config import LAYOUT_FIELDS, RaftConfig
+from raft_tpu.sim import checkpoint, pkernel, state
+from raft_tpu.sim.run import metrics_init, run
+from raft_tpu.utils.trees import trees_equal, trees_equal_why
+
+# The shared fast-tier differential universe (kmesh.faulted_64_cfg's
+# shape): crash + partition + drop churn so restarts, truncations and
+# ring churn actually exercise the packed lanes.
+FAULTED = RaftConfig(n_groups=64, k=3, seed=23, drop_prob=0.05,
+                     crash_prob=0.2, crash_epoch=16, partition_prob=0.2,
+                     partition_epoch=16, log_cap=8, compact_every=4)
+
+PACKED = dict(pack_bools=True, pack_ring=True)
+
+
+def _headline():
+    return RaftConfig(seed=42)
+
+
+def _clients():
+    return dataclasses.replace(_headline(), sessions=True, cmds_per_tick=0,
+                               client_rate=0.2, client_slots=4,
+                               client_retry_backoff=8)
+
+
+# ------------------------------------------------------------ byte model
+
+
+def test_packed_wire_models_pinned():
+    """The new modeled sizes, pinned EXACTLY (the r13 analogue of the
+    8,308/11,056 pins): packing shaves 1,172 B/group at the headline
+    config (856 B of bit-packed bools + 316 B of ring deltas) and the
+    same 1,172 B on the client universe; the off-path baselines are
+    untouched."""
+    off, on = _headline(), dataclasses.replace(_headline(), **PACKED)
+    assert 4 * pkernel.wire_words_per_group(off) == 8_308
+    assert 4 * pkernel.wire_words_per_group(on) == 7_136
+    c_off = _clients()
+    c_on = dataclasses.replace(c_off, **PACKED)
+    assert 4 * pkernel.wire_words_per_group(c_off) == 11_056
+    assert 4 * pkernel.wire_words_per_group(c_on) == 9_884
+    # Telemetry dials: hist rows −2,048 B, flight ring −1,536 B.
+    ceiling_cfg = dataclasses.replace(on, wire_hist=False)
+    assert 4 * pkernel.wire_words_per_group(ceiling_cfg) == 7_136 - 2_048
+    assert 4 * pkernel.wire_words_per_group(
+        ceiling_cfg, with_flight=False) == 7_136 - 2_048 - 1_536
+
+
+def test_alias_halves_residency_and_ceiling_multiplies():
+    """hbm_bytes under alias_wire is exactly half the no-donation
+    model, and the full dial stack clears the ISSUE acceptance bar:
+    modeled single-chip ceiling >= 2.5x the 1.03M-group r12 baseline
+    at the headline config."""
+    off = _headline()
+    aliased = dataclasses.replace(off, alias_wire=True)
+    g = 4 * pkernel.GB
+    assert pkernel.hbm_bytes(aliased, g) * 2 == pkernel.hbm_bytes(off, g)
+    base_ceiling = pkernel.hbm_ceiling_groups(off)
+    assert base_ceiling == 1_033_216   # the DESIGN.md §9 figure
+    all_dials = dataclasses.replace(off, alias_wire=True, wire_hist=False,
+                                    **PACKED)
+    full = pkernel.hbm_ceiling_groups(all_dials, with_flight=False)
+    assert full >= 2.5 * base_ceiling
+    # Every ceiling stays the exact supported() boundary.
+    assert pkernel.supported(all_dials, n_groups=full, with_flight=False)
+    assert not pkernel.supported(all_dials, n_groups=full + pkernel.GB,
+                                 with_flight=False)
+
+
+def test_packed_wire_model_matches_real_leaves():
+    """The three-accounting reconciliation at the packed sizes: real
+    kinit leaf elements == the packed registry == the independently
+    derived byte model, flight on and off, for every audited layout."""
+    from raft_tpu import sim
+    from raft_tpu.analysis import bytemodel
+    from raft_tpu.obs import flight_init
+
+    for label, cfg in bytemodel.audit_cfgs():
+        for wf in (True, False):
+            model = bytemodel.derived_wire_model(cfg, with_flight=wf)
+            assert model["problems"] == [], (label, wf, model["problems"])
+    cfg = dataclasses.replace(FAULTED, **PACKED)
+    st0 = sim.init(cfg, n_groups=64)
+    for flight in (None, flight_init(64)):
+        leaves, _ = pkernel.kinit(cfg, st0, flight=flight)
+        actual = sum(int(np.prod(a.shape)) for a in leaves) // pkernel.GB
+        assert actual == pkernel.wire_words_per_group(
+            cfg, with_flight=flight is not None)
+
+
+def test_roofline_tracks_packed_byte_model():
+    """Satellite: predicted bytes/tick follows the packed model with no
+    second accounting — packing on AND off (the off path IS the 8,308 /
+    11,056 pin), and the XLA resident model is layout-blind (packing
+    changes the kernel wire, not what the scan keeps resident)."""
+    from raft_tpu.obs import roofline
+
+    for cfg, pin in ((_headline(), 8_308), (_clients(), 11_056)):
+        packed = dataclasses.replace(cfg, **PACKED)
+        r_off = roofline.roofline(cfg, 100_000, "pallas-fused-chunk",
+                                  chunk_ticks=200, flops=False)
+        r_on = roofline.roofline(packed, 100_000, "pallas-fused-chunk",
+                                 chunk_ticks=200, flops=False)
+        assert r_off["wire_bytes_per_group"] == pin
+        assert r_on["wire_bytes_per_group"] \
+            == 4 * pkernel.wire_words_per_group(packed)
+        # Traffic model: the wire crosses HBM in AND out once per chunk
+        # regardless of aliasing (aliasing halves residency, not moves).
+        padded = -(-100_000 // pkernel.GB) * pkernel.GB
+        want = 2 * r_on["wire_bytes_per_group"] * padded
+        assert abs(r_on["bytes_per_tick_per_chip"] * 200 - want) \
+            < 1e-6 * want
+        x_off = roofline.roofline(cfg, 100_000, "xla-scan", flops=False)
+        x_on = roofline.roofline(packed, 100_000, "xla-scan", flops=False)
+        assert x_on["bytes_per_tick_per_chip"] \
+            == x_off["bytes_per_tick_per_chip"]
+
+
+# ------------------------------------------------------- encode/decode
+
+
+def test_pack_unpack_round_trip_all_features():
+    """_pack_wire/_unpack_wire are exact inverses on a synthetic wire
+    with every gated feature on (prevote + transfer + reads + clients:
+    12 bool mailbox leaves -> 2 shared words per dst at k=3)."""
+    from raft_tpu import sim
+
+    cfg = dataclasses.replace(
+        FAULTED, prevote=True, transfer_prob=0.5, read_every=4,
+        sessions=True, cmds_per_tick=0, client_rate=0.3, client_slots=2,
+        **PACKED)
+    flat = pkernel._to_kstate(cfg, sim.init(cfg, n_groups=128))
+    names = pkernel._unpacked_names(cfg)
+    booly = set(pkernel._MB_BOOL) | {"votes", "alive_prev"}
+    synth = []
+    for i, (n, a) in enumerate(zip(names, flat)):
+        v = (np.arange(a.size, dtype=np.int64) * (3 * i + 7)) % 11
+        if n in booly:
+            v = v % 2
+        synth.append(jnp.asarray(v.reshape(a.shape), jnp.int32))
+    packed = pkernel._pack_wire(cfg, synth)
+    assert len(packed) == pkernel._n_state_leaves(cfg)
+    back, aux = pkernel._unpack_wire(cfg, packed)
+    assert set(aux) == {"ring_ov"}
+    assert int(np.asarray(aux["ring_ov"]).sum()) == 0
+    for n, a, b in zip(names, synth, back):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), n
+
+
+def test_kinit_kfinish_round_trip_packed():
+    """A mid-run state survives kinit -> kfinish exactly under every
+    dial combination (the host-side halves of the chunk boundary)."""
+    st0 = state.init(FAULTED)
+    st, m = run(FAULTED, st0, 40)
+    for knobs in (dict(pack_bools=True), dict(pack_ring=True), PACKED,
+                  dict(wire_hist=False, **PACKED)):
+        cfg = dataclasses.replace(FAULTED, **knobs)
+        leaves, g = pkernel.kinit(cfg, st, m)
+        st2, _ = pkernel.kfinish(cfg, leaves, g, m)
+        ok, why = trees_equal_why(st, st2)
+        assert ok, (knobs, why)
+
+
+def test_ring_overflow_refused_loudly():
+    """A >= 2^16 in-group term spread cannot be 16-bit delta-encoded:
+    kfinish must raise naming pack_ring, never return silently wrong
+    terms."""
+    cfg = dataclasses.replace(FAULTED, pack_ring=True)
+    st = state.init(FAULTED)
+    lt = np.asarray(st.nodes.log_term).copy()
+    lt[0, 0, 0] = 1 << 17          # spread 2^17 vs the zeros elsewhere
+    st = st._replace(nodes=st.nodes._replace(log_term=jnp.asarray(lt)))
+    leaves, g = pkernel.kinit(cfg, st)
+    with pytest.raises(ValueError, match="pack_ring"):
+        pkernel.kfinish(cfg, leaves, g)
+
+
+# ------------------------------------------------- kernel differentials
+
+
+def test_packed_kernel_bit_identical():
+    """THE r13 gate: the packed kernel (bools + ring deltas), chunked
+    across two launches so the in-kernel re-encode path runs, stays
+    bit-identical to the XLA path on full State AND full Metrics over
+    the faulted universe."""
+    cfg = dataclasses.replace(FAULTED, **PACKED)
+    st0 = state.init(FAULTED)
+    stx, mx = run(FAULTED, st0, 48, 0, metrics_init(64))
+    leaves, g = pkernel.kinit(cfg, st0)
+    leaves = pkernel.kstep(cfg, leaves, 0, 24, interpret=True)
+    leaves = pkernel.kstep(cfg, leaves, 24, 24, interpret=True)
+    stp, mp = pkernel.kfinish(cfg, leaves, g)
+    ok, why = trees_equal_why(stx, stp)
+    assert ok, why
+    ok, why = trees_equal_why(mx, mp, names=list(type(mx)._fields))
+    assert ok, why
+
+
+def test_alias_wire_flag_bit_identical():
+    """cfg.alias_wire routes through the donating jit twin (compiled
+    path) and must be a pure layout decision — interpret-mode results
+    are bit-identical with the flag on."""
+    cfg = dataclasses.replace(FAULTED, alias_wire=True, **PACKED)
+    st0 = state.init(FAULTED)
+    stx, mx = run(FAULTED, st0, 48, 0, metrics_init(64))
+    stp, mp = pkernel.prun(cfg, st0, 48, interpret=True)
+    assert trees_equal(stx, stp)
+    assert trees_equal(mx, mp)
+
+
+def test_wire_hist_dial_state_exact_hist_passthrough():
+    """wire_hist=False: the State stays bit-identical, every non-row
+    metric lane stays bit-identical, and the histogram rows pass
+    through untouched (the kernel tracked nothing) — telemetry as a
+    dial, with the cost visible only in the byte model."""
+    cfg = dataclasses.replace(FAULTED, wire_hist=False)
+    st0 = state.init(FAULTED)
+    stx, mx = run(FAULTED, st0, 48, 0, metrics_init(64))
+    leaves, g = pkernel.kinit(cfg, st0)
+    assert len(leaves) == pkernel._n_state_leaves(cfg) \
+        + pkernel._n_metric_leaves(cfg)
+    assert "hist" not in pkernel._active_metric_leaves(cfg)
+    stp, mp = pkernel.prun(cfg, st0, 48, interpret=True)
+    assert trees_equal(stx, stp)
+    for lane in ("committed", "leaderless", "elections", "max_latency",
+                 "safety"):
+        assert np.array_equal(np.asarray(getattr(mx, lane)),
+                              np.asarray(getattr(mp, lane))), lane
+    assert np.all(np.asarray(mp.hist) == 0)   # pass-through of the base
+
+
+# ------------------------------------------------------------ checkpoint
+
+
+def test_checkpoint_layout_blind_both_directions():
+    """config.LAYOUT_FIELDS never block a resume: a file saved under
+    the packed layout loads under the default one and vice versa, and
+    a pre-r13 file (embedded cfg has no layout keys at all) loads
+    under a packed cfg. Semantic mismatches still refuse."""
+    cfg_off = FAULTED
+    cfg_on = dataclasses.replace(FAULTED, alias_wire=True,
+                                 wire_hist=False, **PACKED)
+    st = state.init(cfg_off, n_groups=4)
+    met = metrics_init(4)
+    for save_cfg, load_cfg in ((cfg_off, cfg_on), (cfg_on, cfg_off)):
+        buf = io.BytesIO()
+        checkpoint.save(buf, st, 9, metrics=met, cfg=save_cfg)
+        buf.seek(0)
+        st2, t2, met2 = checkpoint.load(buf, cfg=load_cfg)
+        assert t2 == 9 and trees_equal(st, st2) and trees_equal(met, met2)
+    # Pre-r13 file: strip the layout keys from the embedded cfg dict.
+    buf = io.BytesIO()
+    checkpoint.save(buf, st, 9, metrics=met, cfg=cfg_off)
+    buf.seek(0)
+    with np.load(buf) as z:
+        data = {k: z[k] for k in z.files}
+    saved = json.loads(bytes(data["__cfg__"]).decode())
+    for k in LAYOUT_FIELDS:
+        assert k in saved   # the strip below must actually strip
+        saved.pop(k)
+    data["__cfg__"] = np.bytes_(json.dumps(saved, sort_keys=True))
+    buf = io.BytesIO()
+    np.savez(buf, **data)
+    buf.seek(0)
+    st2, t2, _ = checkpoint.load(buf, cfg=cfg_on)
+    assert t2 == 9 and trees_equal(st, st2)
+    # A SEMANTIC mismatch still refuses, layout knobs notwithstanding.
+    buf.seek(0)
+    with pytest.raises(ValueError, match="cfg mismatch"):
+        checkpoint.load(buf, cfg=dataclasses.replace(cfg_on, seed=99))
+
+
+def test_engine_hop_packed_wire(tmp_path):
+    """XLA -> checkpoint -> PACKED kernel -> checkpoint -> XLA: the
+    engines agree across a layout change mid-run (the r13 form of the
+    r05 engine-hop test)."""
+    cfg_on = dataclasses.replace(FAULTED, **PACKED)
+    st0 = state.init(FAULTED)
+    stx, _ = run(FAULTED, st0, 32)
+    p = tmp_path / "hop.npz"
+    checkpoint.save(p, st0, 0, cfg=FAULTED)
+    st_loaded, t0, _ = checkpoint.load(p, cfg=cfg_on)
+    stp, _ = pkernel.prun(cfg_on, st_loaded, 32, t0=t0, interpret=True)
+    assert trees_equal(stx, stp)
+
+
+# ------------------------------------------------------------- manifests
+
+
+def test_manifest_packing_keys_present_from_birth_and_backfilled():
+    """r13 satellite: every manifest record carries the packing keys
+    (null until stamped), history.backfill_record nulls them onto
+    pre-r13 records, and the auditor's manifest pass covers both
+    directions (it runs inside the clean-tree audit)."""
+    from raft_tpu.analysis import contracts
+    from raft_tpu.obs import history
+    from raft_tpu.obs.manifest import emit_manifest
+    from raft_tpu.obs.manifest import PACKING_KEYS as PKEYS
+
+    PACKING_KEYS = PKEYS
+    assert tuple(PACKING_KEYS) == tuple(LAYOUT_FIELDS)
+    rec = emit_manifest("probe", FAULTED, path="-")
+    for k in PACKING_KEYS:
+        assert k in rec and rec[k] is None
+    old = {k: v for k, v in rec.items() if k not in PACKING_KEYS}
+    back = history.backfill_record(old)
+    for k in PACKING_KEYS:
+        assert k in back and back[k] is None
+    assert contracts.manifest_problems() == []
+    # Drift detection both directions: an emit side that forgot the
+    # keys, and a backfill side that forgot them.
+
+    class _NoPackManifest:
+        ROOFLINE_KEYS = ("predicted_rounds_per_sec", "attainment_pct",
+                         "bound", "trace_path")
+        PACKING_KEYS = PKEYS
+
+        @staticmethod
+        def emit_manifest(segment, cfg, device=None, path=None, **fields):
+            rec = emit_manifest(segment, cfg, device=device, path="-",
+                                **fields)
+            return {k: v for k, v in rec.items()
+                    if k not in _NoPackManifest.PACKING_KEYS}
+
+    probs = contracts.manifest_problems(manifest_mod=_NoPackManifest)
+    assert any("pack_bools" in p for p in probs)
+
+    class _NoPackHistory:
+        R12_MANIFEST_KEYS = history.R12_MANIFEST_KEYS
+        R13_MANIFEST_KEYS = history.R13_MANIFEST_KEYS
+
+        @staticmethod
+        def backfill_record(rec):
+            out = dict(rec)
+            for k in history.R12_MANIFEST_KEYS:
+                out.setdefault(k, None)
+            return out   # forgot the r13 keys
+
+    probs = contracts.manifest_problems(history_mod=_NoPackHistory)
+    assert any("pack_bools" in p or "backfill" in p for p in probs)
+
+
+def test_kreads_indexes_by_name_on_packed_wire():
+    """The packed layout inserts/removes wire leaves — host-side
+    counter readers must index by name (a positional constant would
+    read a neighbor)."""
+    cfg = dataclasses.replace(FAULTED, read_every=4, **PACKED)
+    st0 = state.init(cfg)
+    leaves, g = pkernel.kinit(cfg, st0)
+    assert pkernel.kreads(cfg, leaves, g) == 0
+    assert pkernel._wire_index(cfg, "group_id") \
+        == pkernel._n_state_leaves(cfg) - 1
