@@ -74,7 +74,7 @@ from raft_tpu.config import RaftConfig
 # and the soak heartbeat.
 from raft_tpu.obs import (dump_flight, emit_manifest, flight_init,
                           run_recorded)
-from raft_tpu.obs.manifest import PACKING_KEYS
+from raft_tpu.obs.manifest import NEMESIS_KEYS, PACKING_KEYS
 from raft_tpu.obs import roofline as obs_roofline
 from raft_tpu.obs import trace as obs_trace
 from raft_tpu.sim.run import (latency_censored, latency_quantile,
@@ -199,6 +199,21 @@ def _packing_fields(cfg) -> dict:
     kernel engine ran with (obs.manifest.PACKING_KEYS, null-by-default
     in every record until stamped here)."""
     return {k: getattr(cfg, k) for k in PACKING_KEYS}
+
+
+def _nemesis_fields(cfg) -> dict:
+    """The r14 manifest stamp: which gray-failure program this
+    segment's universe ran under (obs.manifest.NEMESIS_KEYS,
+    null-by-default in every record until stamped here) — derived from
+    the key registry so a manifest-side rename cannot drift past this
+    producer."""
+    from raft_tpu import nemesis
+    vals = {"nemesis_program_hash": nemesis.program_hash(cfg.nemesis),
+            "nemesis_clauses": nemesis.to_json(cfg.nemesis)}
+    if set(vals) != set(NEMESIS_KEYS):
+        raise RuntimeError(f"obs.manifest.NEMESIS_KEYS {NEMESIS_KEYS} "
+                           f"drifted from the bench producer {set(vals)}")
+    return vals
 
 
 def _roofline_fields(cfg, n_groups: int, engine: str, ticks: int,
@@ -653,6 +668,94 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
     return seg
 
 
+def bench_nemesis(seed: int, n_groups: int, ticks: int, label: str):
+    """Gray-failure segment on BOTH engines (DESIGN.md §14): the
+    canonical nemesis program (`nemesis.gray_mix` — slow-but-alive
+    follower + asymmetric flaky link) composed onto light base churn.
+    Where config-4/5 measure behavior under fail-STOP faults, this
+    segment is the published number for behavior under fail-SLOW ones:
+    committed-round throughput and the election-latency distribution
+    while every group carries a degraded-but-alive node and a silently
+    lossy link the whole run.
+
+    Same from-tick-0 protocol as bench_fault_latency (histogram needs
+    every tick; throwaway-universe warmups; separate walls); kernel
+    promotion under the unchanged full State + Metrics + flight-ring
+    bit-identity gate. The manifest/JSON carry the program's stable
+    hash and clause list (obs.manifest.NEMESIS_KEYS — null on every
+    other segment), so a reader can pair this number against the
+    fail-stop segments without digging through config dicts."""
+    from raft_tpu import nemesis
+    cfg = _seg_cfg(seed=seed, crash_prob=0.1, crash_epoch=64,
+                   drop_prob=0.02, nemesis=nemesis.gray_mix(ticks))
+    log(f"  [{label}] program {nemesis.program_hash(cfg.nemesis)}: "
+        f"{nemesis.describe(cfg.nemesis)}")
+    t0 = time.perf_counter()
+    with obs_trace.span(f"warmup+compile xla [{label}]"):
+        wst, wm, wf = run_recorded(cfg, sim.init(cfg, n_groups=n_groups),
+                                   CHUNK, 0, metrics_init(n_groups),
+                                   flight_init(n_groups))
+        jax.block_until_ready(wst)
+    x_warmup_s = time.perf_counter() - t0
+    log(f"  [xla] warmup chunk (incl. compile): {x_warmup_s:.1f}s")
+    st = sim.init(cfg, n_groups=n_groups)
+    m = metrics_init(n_groups)
+    f = flight_init(n_groups)
+    start = time.perf_counter()
+    with obs_trace.span(f"timed xla [{label}]"):
+        for tick_at in range(0, ticks, CHUNK):
+            n = min(CHUNK, ticks - tick_at)
+            with obs_trace.chunk_span("xla", tick_at, n, phase="timed"):
+                st, m, f = run_recorded(cfg, st, n, tick_at, m, f)
+            obs_trace.heartbeat(label, tick_at + n, m, f)
+        n_elections = int(m.elections)      # fetch closes the timer
+    x_elapsed = time.perf_counter() - start
+    rounds = total_rounds(m)
+    log(f"  [xla] {label} {n_groups} groups x {ticks} ticks in "
+        f"{x_elapsed:.2f}s ({x_elapsed / ticks * 1e3:.2f} ms/tick): "
+        f"{rounds} rounds, {n_elections} elections")
+
+    pal = _pallas_full_run(cfg, n_groups, ticks, "kelections", label,
+                           st, m, f)
+    engine, k_elapsed, k_warmup_s = (pal["engine"], pal["k_elapsed"],
+                                     pal["k_warmup_s"])
+    nd, k_name = pal["nd"], pal["k_name"]
+    elapsed = k_elapsed if pal["promoted"] else x_elapsed
+
+    unsafe = _safety_check(label, m, f, n_groups)
+    p50 = latency_quantile(m.hist, 0.5)
+    p99 = latency_quantile(m.hist, 0.99)
+    censored = latency_censored(m.hist, 0.99)
+    log(f"  {label}: {rounds} rounds ({rounds / elapsed:,.0f} rounds/s "
+        f"under gray failures), {n_elections} elections, p50={p50} "
+        f"p99={p99} max={int(m.max_latency)} ticks"
+        f"{' [p99 CENSORED at histogram top bucket]' if censored else ''}"
+        f"; engine={engine}")
+    seg = {
+        "rounds_per_sec": rounds / elapsed, "rounds": rounds,
+        "elections": n_elections,
+        "p50": p50, "p99": p99, "censored": censored,
+        "max_lat": int(m.max_latency),
+        "engine": engine,
+        "state_identical": pal["state_ok"],
+        "metrics_identical": pal["metrics_ok"],
+        "flight_identical": pal["flight_ok"],
+        "n_groups": n_groups, "ticks": ticks,
+        **_nemesis_fields(cfg),
+        **_wall_fields(elapsed, xla_wall_s=x_elapsed,
+                       xla_warmup_wall_s=x_warmup_s,
+                       kernel_wall_s=k_elapsed,
+                       kernel_warmup_wall_s=k_warmup_s),
+        "safety_ok": unsafe == 0, "unsafe_groups": unsafe,
+        **_mesh_fields(n_groups, nd if engine == k_name else 1),
+        **_roofline_fields(cfg, n_groups, engine, ticks, elapsed,
+                           nd=nd if engine == k_name else 1),
+        **_packing_fields(cfg),
+    }
+    emit_manifest(label, cfg, device=_device_str(), **seg)
+    return seg
+
+
 def bench_election_rounds(n_groups: int, ticks: int):
     """Config 2 shape: pure leader-election rounds — no client commands
     (`cmds_per_tick=0`, so no AppendEntries payload traffic and commits
@@ -934,6 +1037,7 @@ def main():
         r_groups, r_ticks = 1_000, 200
         rd_groups, rd_ticks = 1_000, 200
         cl_groups, cl_ticks = 1_000, 200
+        nm_groups, nm_ticks = 1_000, 200
     else:
         # The headline runs at the true config-5 shape: 100K groups.
         # (History: a TPU kernel fault at 100K groups blocked this shape
@@ -948,6 +1052,7 @@ def main():
         r_groups, r_ticks = 10_000, 2400
         rd_groups, rd_ticks = 50_000, 600   # ReadIndex-at-scale segment
         cl_groups, cl_ticks = 50_000, 600   # client-SLO-at-scale segment
+        nm_groups, nm_ticks = 50_000, 600   # gray-failure segment (§14)
 
     # The trace must survive a mid-run crash: a bench that dies in
     # segment 5 of 6 is exactly the run whose timeline is needed, so
@@ -971,6 +1076,10 @@ def main():
             "exactly-once sessions, both engines):")
         cl = segment("client-slo fault mix", bench_clients, 47, cl_groups,
                      cl_ticks, "client-slo fault mix")
+        log("gray-failure mix (nemesis program on light churn, both "
+            "engines):")
+        nm = segment("nemesis gray mix", bench_nemesis, 48, nm_groups,
+                     nm_ticks, "nemesis gray mix")
 
         # Roofline contract (DESIGN.md §12, ISSUE r12 acceptance): every
         # segment must carry the three stamp fields — a segment emitted
@@ -978,7 +1087,7 @@ def main():
         for name, seg in (("throughput", tp), ("config-4", c4),
                           ("config-5-faults", c5f),
                           ("election-rounds", c2), ("reads", rd),
-                          ("client-slo", cl)):
+                          ("client-slo", cl), ("nemesis", nm)):
             missing = [k for k in obs_roofline.ROOFLINE_FIELDS
                        if k not in seg]
             missing += [k for k in SEGMENT_WALL_KEYS if k not in seg]
@@ -998,7 +1107,7 @@ def main():
     # fold AND endpoint accounting) folds into the global safety bit:
     # a double-apply must trip the same top-level flag automation
     # watches, not only a buried per-segment field.
-    safety_ok = all(s["safety_ok"] for s in (tp, c4, c5f, c2, rd, cl)) \
+    safety_ok = all(s["safety_ok"] for s in (tp, c4, c5f, c2, rd, cl, nm)) \
         and cl["exactly_once_ok"]
     if not safety_ok:
         log("SAFETY: at least one segment dropped the per-tick safety "
@@ -1081,6 +1190,19 @@ def main():
         "client_state_identical": cl["state_identical"],
         "client_safety_ok": cl["safety_ok"],
         "client_workload": cl["workload"],
+        # Gray-failure SLO (DESIGN.md §14): the published number for
+        # behavior under fail-SLOW faults — every group carries a
+        # degraded-but-alive node and a silently lossy link the whole
+        # run (nemesis.gray_mix), next to the fail-stop configs above.
+        "nemesis_rounds_per_sec": round(nm["rounds_per_sec"], 1),
+        "nemesis_p50_election_latency_ticks": nm["p50"],
+        "nemesis_p99_election_latency_ticks": nm["p99"],
+        "nemesis_p99_censored": nm["censored"],
+        "nemesis_elections_observed": nm["elections"],
+        "nemesis_program_hash": nm["nemesis_program_hash"],
+        "nemesis_engine": nm["engine"],
+        "nemesis_state_identical": nm["state_identical"],
+        "nemesis_safety_ok": nm["safety_ok"],
         "device": f"{dev.platform}:{dev.device_kind}",
     }))
 

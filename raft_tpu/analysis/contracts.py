@@ -40,7 +40,26 @@ GATED_LEAVES = {
     "clients": (("is_req_snap_sessions",),
                 ("session_seq", "snap_session_seq"),
                 ("clients",)),
+    # The nemesis scenario compiler (DESIGN.md §14) gates NOTHING: a
+    # compiled program is pure hash masks over existing schedules —
+    # zero new State leaves, zero new wire lanes. The empty row is the
+    # contract (like read_every), enforced by the gating pass AND by
+    # nemesis_problems below.
+    "nemesis": ((), (), ()),
 }
+
+
+def _nemesis_probe_program() -> tuple:
+    """A program exercising every clause kind — the gating/nemesis
+    passes' probe (built inline; analysis must not import the nemesis
+    package at module level)."""
+    from raft_tpu.nemesis.program import (clock_skew, crash_storm,
+                                          flaky_link, partition_wave,
+                                          program, slow_follower,
+                                          wan_delay)
+    return program(slow_follower(0, 64), flaky_link(0, 64),
+                   wan_delay(0, 64), clock_skew(0, 64),
+                   crash_storm(0, 64), partition_wave(0, 64))
 
 
 def _base_cfg() -> RaftConfig:
@@ -56,6 +75,8 @@ def _gate_cfgs() -> dict:
         "clients": dataclasses.replace(base, sessions=True,
                                        cmds_per_tick=0, client_rate=0.3,
                                        client_slots=2),
+        "nemesis": dataclasses.replace(base,
+                                       nemesis=_nemesis_probe_program()),
     }
 
 
@@ -213,7 +234,8 @@ def wire_registry_problems(pernode_fields: tuple | None = None,
         for gate, (mb, _, _) in GATED_LEAVES.items():
             on = {"prevote": cfg.prevote,
                   "transfer": cfg.transfer_u32 != 0,
-                  "clients": clients}[gate]
+                  "clients": clients,
+                  "nemesis": bool(cfg.nemesis)}[gate]
             if not on:
                 gated_mb.update(mb)
         want_mb = [f for f in mailbox_fields if f not in gated_mb]
@@ -547,15 +569,24 @@ def checkpoint_problems(ckpt_mod=None,
                         "must fill zeros (registry: checkpoint.load "
                         "client-lane backfill)")
 
-    # Pre-r09 cfg backfill: a saved cfg dict missing a later-added knob
-    # loads against that knob's default.
+    # Pre-r09/r14 cfg backfill: a saved cfg dict missing a later-added
+    # knob (client knobs; the r14 nemesis program) loads against that
+    # knob's default.
     cfg_label = "base"
-    r = roundtrip(_base_cfg(), patch_cfg=("client_rate", "client_slots"))
+    r = roundtrip(_base_cfg(), patch_cfg=("client_rate", "client_slots",
+                                          "nemesis"))
     if r is None or isinstance(r, Exception):
         problems.append("cfg-default backfill drift: a checkpoint whose "
                         "embedded cfg predates a knob must load against "
                         "the knob's default (registry: checkpoint.load "
                         "cfg setdefault)")
+    # ...and the converse must REFUSE: a nemesis-on run resuming a
+    # pre-r14 file (whose embedded cfg backfills to nemesis=[]) would
+    # silently continue a DIFFERENT universe schedule.
+    roundtrip(_base_cfg(), patch_cfg=("nemesis",),
+              load_cfg=dataclasses.replace(
+                  _base_cfg(), nemesis=_nemesis_probe_program()),
+              expect_raise=(ValueError,))
 
     # Strictness: a missing REQUIRED leaf must raise, naming the field.
     r = roundtrip(_base_cfg(), strip=("state.nodes.term",),
@@ -718,6 +749,114 @@ def packing_problems(include_behavioral: bool = True) -> list[str]:
     return problems
 
 
+# ---------------------------------------------------- nemesis compiler
+
+
+def nemesis_problems(kinds: tuple | None = None,
+                     link_kinds: tuple | None = None,
+                     crash_kinds: tuple | None = None,
+                     timing_kinds: tuple | None = None) -> list[str]:
+    """The nemesis scenario compiler's contracts (DESIGN.md §14):
+
+    - compiled programs add ZERO leaves — GATED_LEAVES carries the
+      empty 'nemesis' row, a kinds-complete program changes neither the
+      State pytree nor any kernel wire registry nor the byte model
+      (kleaf_spec has nothing new to cover, proven by the counts);
+    - the seam partition is TOTAL: every clause kind is routed to
+      exactly one engine seam (link / crash / timing filter) — a kind
+      in none would be a silently-ignored clause, a kind in two would
+      double-apply;
+    - the program builders cover every kind and `RaftConfig` normalizes
+      a JSON-round-tripped program back to the identical hashable form;
+    - utils.rng / utils.jrng evaluator parity rides the existing
+      rng_parity pass (same exports, same signatures).
+
+    Pass drifted kind tuples to prove the auditor names the drift —
+    the synthetic-drift hook (tests/test_analysis.py pattern)."""
+    import jax
+
+    from raft_tpu import sim
+    from raft_tpu.nemesis.program import KIND_NAMES, from_json, to_json
+    from raft_tpu.sim import pkernel
+    from raft_tpu.utils import rng as _r
+
+    kinds = _r.NEM_KINDS if kinds is None else tuple(kinds)
+    link_kinds = _r.NEM_LINK_KINDS if link_kinds is None \
+        else tuple(link_kinds)
+    crash_kinds = _r.NEM_CRASH_KINDS if crash_kinds is None \
+        else tuple(crash_kinds)
+    timing_kinds = _r.NEM_TIMING_KINDS if timing_kinds is None \
+        else tuple(timing_kinds)
+
+    problems = []
+    # Seam partition: every kind on exactly one seam.
+    routed = list(link_kinds) + list(crash_kinds) + list(timing_kinds)
+    unrouted = [k for k in kinds if k not in routed]
+    if unrouted:
+        problems.append(
+            f"nemesis kinds {unrouted} routed to NO engine seam "
+            f"(NEM_LINK/CRASH/TIMING_KINDS) — their clauses would be "
+            f"silently ignored by every engine")
+    if len(routed) != len(set(routed)):
+        dup = sorted({k for k in routed if routed.count(k) > 1})
+        problems.append(f"nemesis kinds {dup} routed to MORE than one "
+                        f"seam — their clauses would double-apply")
+    ghost = [k for k in routed if k not in kinds]
+    if ghost:
+        problems.append(f"seam filters route unknown nemesis kinds "
+                        f"{ghost} (not in NEM_KINDS)")
+    # Builder coverage: every kind constructible through the DSL.
+    built = {c[0] for c in _nemesis_probe_program()}
+    missing = [k for k in kinds if k not in built]
+    if missing:
+        problems.append(
+            f"nemesis kinds {missing} have no program.py builder "
+            f"(KIND_NAMES knows {sorted(KIND_NAMES)}) — a kind the "
+            f"DSL cannot express cannot be searched or shrunk")
+
+    # Zero extra leaves, zero wire drift, zero byte-model drift.
+    base = _base_cfg()
+    on = dataclasses.replace(base, nemesis=_nemesis_probe_program())
+    if _leaf_names(on) != _leaf_names(base):
+        problems.append(
+            "a compiled nemesis program changed the State pytree leaves "
+            "— the compiler's whole contract is hash masks over "
+            "EXISTING schedules (GATED_LEAVES 'nemesis' row is empty)")
+    for fn in (pkernel._mb_fields, pkernel._n_state_leaves,
+               pkernel._active_metric_leaves, pkernel.wire_words_per_group):
+        if fn(on) != fn(base):
+            problems.append(
+                f"a compiled nemesis program changed pkernel.{fn.__name__} "
+                f"— no new wire lanes are allowed (kleaf_spec would not "
+                f"cover them)")
+    if [f for f, _ in pkernel._node_leaves(on)] \
+            != [f for f, _ in pkernel._node_leaves(base)]:
+        problems.append("a compiled nemesis program changed "
+                        "pkernel._node_leaves")
+    # kinit emits the identical wire-leaf set (eval_shape, no device).
+    st_b = jax.eval_shape(lambda: sim.init(base, n_groups=2))
+    st_o = jax.eval_shape(lambda: sim.init(on, n_groups=2))
+    lv_b = jax.eval_shape(lambda s: pkernel.kinit(base, s)[0], st_b)
+    lv_o = jax.eval_shape(lambda s: pkernel.kinit(on, s)[0], st_o)
+    if [(tuple(a.shape), str(a.dtype)) for a in lv_b] \
+            != [(tuple(a.shape), str(a.dtype)) for a in lv_o]:
+        problems.append("a compiled nemesis program changed the kinit "
+                        "wire leaves (shape/dtype drift)")
+
+    # JSON round trip: RaftConfig normalization keeps the program
+    # hashable and equal through a manifest/checkpoint config dict.
+    d = json.loads(json.dumps(dataclasses.asdict(on)))
+    if RaftConfig(**d) != on or hash(RaftConfig(**d)) != hash(on):
+        problems.append(
+            "RaftConfig.nemesis does not survive a JSON round trip as "
+            "an equal, hashable static config — jit caching and the "
+            "checkpoint cfg match would both break")
+    if from_json(to_json(on.nemesis)) != on.nemesis:
+        problems.append("nemesis program to_json/from_json round trip "
+                        "is not the identity")
+    return problems
+
+
 # ------------------------------------------------------- manifest schema
 
 
@@ -735,7 +874,15 @@ def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
     man = real_manifest if manifest_mod is None else manifest_mod
     hist = real_history if history_mod is None else history_mod
     problems = []
-    keys = real_manifest.ROOFLINE_KEYS + real_manifest.PACKING_KEYS
+    keys = (real_manifest.ROOFLINE_KEYS + real_manifest.PACKING_KEYS
+            + real_manifest.NEMESIS_KEYS)
+    if tuple(real_history.R14_MANIFEST_KEYS) \
+            != tuple(real_manifest.NEMESIS_KEYS):
+        problems.append(
+            f"obs.history.R14_MANIFEST_KEYS {real_history.R14_MANIFEST_KEYS}"
+            f" != obs.manifest.NEMESIS_KEYS "
+            f"{real_manifest.NEMESIS_KEYS} — the emit-side and "
+            f"backfill-side key lists drifted")
     if tuple(real_history.R12_MANIFEST_KEYS) \
             != tuple(real_manifest.ROOFLINE_KEYS):
         problems.append(
@@ -840,6 +987,7 @@ def contract_problems(include_behavioral: bool = True) -> list[str]:
     out += shard_rule_problems()
     out += packing_problems(include_behavioral=include_behavioral)
     out += checkpoint_problems(include_behavioral=include_behavioral)
+    out += nemesis_problems()
     out += manifest_problems()
     out += rng_parity_problems()
     return out

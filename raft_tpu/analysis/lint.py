@@ -34,9 +34,21 @@ import dataclasses
 import os
 from typing import Iterable
 
-# Default lint surface: the three modules whose purity the engines'
-# bit-identity contract depends on.
-DEFAULT_TARGETS = ("sim/step.py", "sim/pkernel.py", "clients/workload.py")
+# Default lint surface: the modules whose purity the engines'
+# bit-identity contract depends on. r14 adds the nemesis compiler
+# (utils/jrng.py hosts the compiled-program evaluators — its nem_*
+# bodies must stay elementwise so one implementation serves the XLA
+# layouts and the kernel tiles — and the nemesis package must stay
+# free of untagged randomness: the SEARCH itself draws only hash_u32).
+DEFAULT_TARGETS = ("sim/step.py", "sim/pkernel.py", "clients/workload.py",
+                   "utils/jrng.py", "nemesis/program.py",
+                   "nemesis/search.py")
+
+# The jrng functions the elementwise rule covers (the compiled nemesis
+# evaluators — DESIGN.md §14; the rest of jrng predates the rule and is
+# already pinned elementwise by its kernel use).
+NEM_EVAL_FNS = ("nem_link_ok", "nem_alive", "nem_deadline_extra",
+                "_nem_active")
 
 # Pytree / array annotations that seed traced-ness for parameters.
 ARRAY_TYPES = {"PerNode", "Mailbox", "State", "ClientState", "Metrics",
@@ -383,12 +395,18 @@ def lint_file(path: str, *, workload_rules: bool | None = None
     out += _lint_traced_branches(tree, path)
     if workload_rules:
         out += _lint_workload_elementwise(tree, path)
+    if os.path.basename(path) == "jrng.py":
+        # The compiled nemesis evaluators share the workload rule's
+        # contract: purely elementwise, so the one jnp implementation
+        # serves both engine layouts (and Mosaic can lower it).
+        out += _lint_workload_elementwise(tree, path, fns=NEM_EVAL_FNS)
     return out
 
 
 def lint_default() -> list[Finding]:
-    """Lint the contract surface: sim/step.py, sim/pkernel.py,
-    clients/workload.py (resolved relative to the installed package)."""
+    """Lint the contract surface (`DEFAULT_TARGETS`: the engine tick
+    modules, the client workload, the jrng evaluators, and the nemesis
+    package, resolved relative to the installed package)."""
     import raft_tpu
     root = os.path.dirname(os.path.abspath(raft_tpu.__file__))
     out = []

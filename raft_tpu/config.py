@@ -10,6 +10,12 @@ from __future__ import annotations
 
 import dataclasses
 
+# Clause-kind constants of the nemesis scenario compiler (DESIGN.md
+# §14). utils/rng.py is the layering bottom (it imports nothing from
+# the repo), so the kind registry lives there and both this module's
+# seam filters and raft_tpu/nemesis/program.py's builders import it.
+from raft_tpu.utils import rng as _nem
+
 _U32 = 0xFFFFFFFF
 
 # Log-entry payload encoding. Client payloads are 30-bit hashes; a set
@@ -197,7 +203,74 @@ class RaftConfig:
     alias_wire: bool = False
     wire_hist: bool = True
 
+    # Nemesis gray-failure program (DESIGN.md §14): a tuple of 8-int
+    # clauses (kind, t0, t1, group_u32, p_u32, a, b, cid) built by
+    # raft_tpu/nemesis/program.py. SEMANTIC (part of the universe
+    # schedule, included in config_hash and the checkpoint match) and
+    # static: each clause compiles to pure (seed, TAG_NEM_*, cid,
+    # coords) hashes evaluated identically by all three engines at the
+    # existing fault seams — no new state, no new wire lanes, and the
+    # default () leaves every compiled program byte-identical to
+    # pre-r14. Normalized in __post_init__ to plain int tuples so a
+    # config rebuilt from JSON (checkpoint/manifest dicts) stays
+    # hashable and equal to the original.
+    nemesis: tuple = ()
+
     def __post_init__(self):
+        norm = []
+        for c in self.nemesis:
+            c = tuple(int(x) for x in c)
+            if len(c) != 8:
+                raise ValueError(
+                    f"nemesis clause {c} must have 8 fields "
+                    f"(kind, t0, t1, group_u32, p_u32, a, b, cid)")
+            kind, t0, t1, group_u32, p_u32, a, b, cid = c
+            if kind not in _nem.NEM_KINDS:
+                raise ValueError(f"nemesis clause kind {kind} unknown "
+                                 f"(known: {_nem.NEM_KINDS})")
+            if not 0 <= t0 <= t1:
+                raise ValueError(f"nemesis clause span [{t0}, {t1}) invalid")
+            if not (0 <= group_u32 <= _U32 and 0 <= p_u32 <= _U32):
+                raise ValueError(
+                    f"nemesis clause thresholds ({group_u32}, {p_u32}) "
+                    f"outside u32")
+            # a/b range: the jnp twins cast them to u32 lanes (i32 for
+            # the signed skew amount) — an out-of-range value from a
+            # hand-edited artifact would be a silent no-op on the host
+            # evaluator but an OverflowError (or worse, a wrapped,
+            # DIFFERENT schedule) at trace time on the engines.
+            if kind == _nem.NEM_SKEW:
+                if not -2**31 <= a < 2**31:
+                    raise ValueError(f"nemesis skew amount {a} outside "
+                                     f"i32")
+            elif not 0 <= a <= _U32:
+                raise ValueError(f"nemesis clause a={a} outside u32")
+            if not 0 <= b <= _U32:
+                raise ValueError(f"nemesis clause b={b} outside u32")
+            if kind in (_nem.NEM_FLAKY, _nem.NEM_STORM, _nem.NEM_WAVE) \
+                    and a < 1:
+                raise ValueError(f"nemesis clause kind {kind} needs its "
+                                 f"epoch/period a >= 1, got {a}")
+            if kind == _nem.NEM_SLOW and a not in (1, 2, 3):
+                # A 0/out-of-range direction mask would be a silent
+                # no-op on the oracle and a misleading "no link clause"
+                # trace error on the jnp engines — refuse it at the
+                # boundary every hand-edited artifact/manifest dict
+                # crosses.
+                raise ValueError(f"nemesis slow-follower clause needs "
+                                 f"direction a in (1, 2, 3), got {a}")
+            if kind == _nem.NEM_WAN and a < 2:
+                raise ValueError(f"nemesis WAN clause needs >= 2 sites, "
+                                 f"got {a}")
+            if cid < 0:
+                raise ValueError(
+                    f"nemesis clause cid {cid} unassigned — build "
+                    f"programs via raft_tpu.nemesis.program()")
+            norm.append(c)
+        if len({c[7] for c in norm}) != len(norm):
+            raise ValueError("nemesis clause cids must be unique — a "
+                             "duplicate cid aliases two clauses' draws")
+        object.__setattr__(self, "nemesis", tuple(norm))
         assert not self.sessions or self.cmds_per_tick == 0, (
             "sessions=True needs cmds_per_tick=0: scheduled payloads hash "
             "the full 30-bit space, so bit 29 would be misread as session "
@@ -285,3 +358,25 @@ class RaftConfig:
     @property
     def partition_u32(self) -> int:
         return _prob_to_u32(self.partition_prob)
+
+    # Nemesis seam filters (DESIGN.md §14): the kind-partitioned
+    # subprograms each engine seam statically gates on — link clauses
+    # into the delivery filter, storm clauses into the aliveness mask,
+    # skew clauses into the deadline draw. The partition is proven
+    # total by analysis.contracts.nemesis_problems (a kind filtered by
+    # no seam would be a silently-ignored clause).
+
+    @property
+    def nem_link(self) -> tuple:
+        return tuple(c for c in self.nemesis
+                     if c[0] in _nem.NEM_LINK_KINDS)
+
+    @property
+    def nem_crash(self) -> tuple:
+        return tuple(c for c in self.nemesis
+                     if c[0] in _nem.NEM_CRASH_KINDS)
+
+    @property
+    def nem_skew(self) -> tuple:
+        return tuple(c for c in self.nemesis
+                     if c[0] in _nem.NEM_TIMING_KINDS)

@@ -81,9 +81,17 @@ class Cluster:
         if self.alive_fn is not None:
             return list(self.alive_fn(tick))
         cfg = self.cfg
-        return [rng.node_alive(cfg.seed, self.g, i, tick,
-                               cfg.crash_u32, cfg.crash_epoch)
-                for i in range(cfg.k)]
+        out = [rng.node_alive(cfg.seed, self.g, i, tick,
+                              cfg.crash_u32, cfg.crash_epoch)
+               for i in range(cfg.k)]
+        nem_crash = cfg.nem_crash   # one program filter per call
+        if nem_crash:
+            # Nemesis crash-storm clauses AND into the base schedule
+            # (DESIGN.md §14) — the batched tick applies the same mask.
+            out = [a and rng.nem_alive(cfg.seed, nem_crash, self.g,
+                                       i, tick)
+                   for i, a in enumerate(out)]
+        return out
 
     # ------------------------------------------------------------ invariants
 

@@ -159,9 +159,17 @@ class Node:
 
     def _reset_election_timer(self):
         self.election_elapsed = 0
-        self.deadline = rng.election_deadline(
+        deadline = rng.election_deadline(
             self.cfg.seed, self.g, self.id, self.rng_draws,
             self.cfg.election_min, self.cfg.election_range)
+        nem_skew = self.cfg.nem_skew
+        if nem_skew:
+            # Nemesis clock-skew clauses (DESIGN.md §14): the draw made
+            # at tick `now` is skewed while a span covers it, clamped
+            # at 1 — the batched `_reset_timer` mirrors this exactly.
+            deadline = max(1, deadline + rng.nem_deadline_extra(
+                self.cfg.seed, nem_skew, self.g, self.id, self.now))
+        self.deadline = deadline
         self.rng_draws += 1
 
     def _step_down(self, new_term: int):

@@ -40,7 +40,8 @@ class Transport:
         """
         cfg = self.cfg
         inboxes: List[List[rpc.Msg]] = [[] for _ in range(cfg.k)]
-        for m in self._outbox:
+        nem_link = cfg.nem_link   # one program filter per tick, not
+        for m in self._outbox:    # one per in-flight message
             if not alive_now[m.dst]:
                 continue
             if self.link_filter is not None and not self.link_filter(
@@ -52,6 +53,10 @@ class Transport:
             if rng.link_dropped(cfg.seed, self.g, tick, m.src, m.dst,
                                 cfg.drop_u32):
                 continue
+            if nem_link and not rng.nem_link_ok(
+                    cfg.seed, nem_link, self.g, tick, m.src, m.dst,
+                    cfg.k):
+                continue   # nemesis link clause blocked it (DESIGN.md §14)
             inboxes[m.dst].append(m)
         self._outbox = []
         return inboxes

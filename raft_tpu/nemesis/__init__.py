@@ -1,0 +1,22 @@
+"""Nemesis scenario compiler (DESIGN.md §14): declarative gray-failure
+programs compiled to the hashed elementwise schedule form all three
+engines share, plus the coverage-guided adversarial search and the
+auto-shrinking minimal-reproducer machinery.
+
+- ``program`` — the clause builders / JSON / hashing (no jax; safe to
+  import from anywhere, including the engines' static gates).
+- ``search`` — scoring, deterministic mutation, shrinking, artifacts
+  (imports the engines; NOT imported here at module level so
+  ``sim.step -> nemesis.program`` can never become a cycle).
+"""
+
+from raft_tpu.nemesis.program import (Clause, clock_skew, crash_storm,
+                                      describe, flaky_link, from_json,
+                                      gray_mix, partition_wave, program,
+                                      program_hash, slow_follower, to_json,
+                                      wan_delay)
+
+__all__ = ["Clause", "clock_skew", "crash_storm", "describe",
+           "flaky_link", "from_json", "gray_mix", "partition_wave",
+           "program", "program_hash", "slow_follower", "to_json",
+           "wan_delay"]

@@ -1,0 +1,197 @@
+"""Declarative gray-failure programs (DESIGN.md §14).
+
+A nemesis *program* is a tuple of composable clauses — slow-but-alive
+followers, asymmetric flaky links, WAN-style heterogeneous delivery,
+timeout/clock skew, crash-recovery storms, correlated partition waves —
+each with a tick span and a per-group participation probability. The
+builders here quantize every probability to a u32 threshold at
+construction (the same `config._prob_to_u32` rule every fault knob
+uses), so a program is nothing but ints: `RaftConfig(nemesis=prog)`
+carries it as a static, hashable, JSON-round-trippable part of the
+semantic config, and the compiled form (`utils.rng.nem_*` and its
+bit-identical `utils.jrng` twins) evaluates it as pure
+`(seed, TAG_NEM_*, cid, coords)` hashes on all three engines.
+
+Clause identity: every clause owns a `cid` that domain-separates all of
+its hash draws. `program()` assigns cids positionally ONCE; the
+shrinker (`nemesis.search`) then drops/narrows clauses WITHOUT
+renumbering, so a surviving clause's schedule is bit-identical in the
+shrunk program — minimization is behavior-preserving per clause.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from raft_tpu.config import _prob_to_u32
+from raft_tpu.utils import rng as _r
+
+# JSON names of the clause kinds (stable; the artifact format and the
+# manifest clause list both use them).
+KIND_NAMES = {
+    _r.NEM_SLOW: "slow_follower",
+    _r.NEM_FLAKY: "flaky_link",
+    _r.NEM_WAN: "wan_delay",
+    _r.NEM_SKEW: "clock_skew",
+    _r.NEM_STORM: "crash_storm",
+    _r.NEM_WAVE: "partition_wave",
+}
+KIND_IDS = {v: k for k, v in KIND_NAMES.items()}
+
+FIELDS = ("kind", "t0", "t1", "group_u32", "p_u32", "a", "b", "cid")
+
+_UNASSIGNED = -1
+
+
+class Clause(NamedTuple):
+    """One gray-failure clause — the 8-int wire layout utils.rng
+    destructures. Field meaning per kind: see utils/rng.py's nemesis
+    block (the one semantics definition site)."""
+    kind: int
+    t0: int
+    t1: int
+    group_u32: int
+    p_u32: int
+    a: int = 0
+    b: int = 0
+    cid: int = _UNASSIGNED
+
+
+def _clause(kind, t0, t1, groups, p, a=0, b=0):
+    if not 0 <= t0 <= t1:
+        raise ValueError(f"clause span [{t0}, {t1}) invalid")
+    return Clause(kind=kind, t0=int(t0), t1=int(t1),
+                  group_u32=_prob_to_u32(groups), p_u32=_prob_to_u32(p),
+                  a=int(a), b=int(b))
+
+
+def slow_follower(t0, t1, p=0.8, direction=3, groups=1.0):
+    """Slow-but-alive follower: one hash-chosen node per participating
+    group keeps ticking but its links drop w.p. `p` per tick.
+    `direction`: 1 = messages FROM it, 2 = TO it, 3 = both."""
+    if direction not in (1, 2, 3):
+        raise ValueError(f"direction {direction} not in (1, 2, 3)")
+    return _clause(_r.NEM_SLOW, t0, t1, groups, p, a=direction)
+
+
+def flaky_link(t0, t1, p=0.9, burst_epoch=8, burst_p=0.5, groups=1.0):
+    """Asymmetric flaky link: ONE hash-chosen ordered (src -> dst) pair
+    drops w.p. `p`, only inside bursts — `burst_epoch`-tick sub-epochs
+    firing w.p. `burst_p`. The reverse direction is untouched."""
+    if burst_epoch < 1:
+        raise ValueError("burst_epoch must be >= 1")
+    return _clause(_r.NEM_FLAKY, t0, t1, groups, p, a=burst_epoch,
+                   b=_prob_to_u32(burst_p))
+
+
+def wan_delay(t0, t1, sites=3, p=0.5, groups=1.0):
+    """Heterogeneous WAN delivery: nodes hash onto `sites` sites;
+    cross-site links drop w.p. `p` per tick. In the tick-synchronous
+    model (heartbeat-driven retransmission) this IS added latency: a
+    link losing each delivery w.p. p delays its information by a
+    geometric number of resend rounds."""
+    if sites < 2:
+        raise ValueError("sites must be >= 2")
+    return _clause(_r.NEM_WAN, t0, t1, groups, p, a=sites)
+
+
+def clock_skew(t0, t1, amount=8, node_p=0.5, groups=1.0):
+    """Timeout/clock skew: nodes selected w.p. `node_p` add the SIGNED
+    `amount` ticks to every election-deadline draw made during the
+    span (negative = a fast clock that times out early and campaigns
+    aggressively; the skewed deadline clamps at 1)."""
+    return _clause(_r.NEM_SKEW, t0, t1, groups, node_p, a=amount)
+
+
+def crash_storm(t0, t1, p=0.4, epoch=4, groups=1.0):
+    """Crash-recovery storm: a second, faster crash schedule — per
+    node per `epoch`-tick sub-epoch, down w.p. `p` — ANDed into the
+    base crash mask for the span."""
+    if epoch < 1:
+        raise ValueError("epoch must be >= 1")
+    return _clause(_r.NEM_STORM, t0, t1, groups, p, a=epoch)
+
+
+def partition_wave(t0, t1, period=32, width=12, leak_p=1.0, groups=1.0):
+    """Correlated partition wave: a `width`-tick partition window
+    sweeps the fleet with `period` (group g enters it g ticks after
+    g-1 — correlated across the fleet, unlike the epoch-hash base
+    schedule). Sides re-draw each period; cross-side links drop w.p.
+    `leak_p` (below 1.0 = a gray, leaky partition)."""
+    if period < 1 or width < 0:
+        raise ValueError("period must be >= 1 and width >= 0")
+    return _clause(_r.NEM_WAVE, t0, t1, groups, leak_p, a=period, b=width)
+
+
+def program(*clauses) -> tuple:
+    """Assemble clauses into a program: assign fresh cids to builder
+    output (positional), keep explicit cids (a shrunk program re-built
+    through here keeps its surviving clauses' schedules bit-identical),
+    and reject duplicates."""
+    taken = {c[7] for c in clauses if c[7] != _UNASSIGNED}
+    out, nxt = [], 0
+    for c in clauses:
+        c = Clause(*(int(x) for x in c))
+        if c.cid == _UNASSIGNED:
+            while nxt in taken:
+                nxt += 1
+            c = c._replace(cid=nxt)
+            taken.add(nxt)
+        out.append(c)
+    if len({c.cid for c in out}) != len(out):
+        raise ValueError("duplicate clause cids")
+    return tuple(out)
+
+
+def gray_mix(n_ticks: int, t0: int = 0) -> tuple:
+    """THE canonical gray-failure program (slow-follower + flaky-link
+    mix): the acceptance-gate universe shared by tests/test_nemesis.py,
+    `kernel_sweep.py --nemesis`, and bench.py's nemesis segment —
+    defined once so the three drivers exercise the same program."""
+    return program(
+        slow_follower(t0, t0 + n_ticks, p=0.7, direction=3),
+        flaky_link(t0, t0 + n_ticks, p=0.9, burst_epoch=8, burst_p=0.6),
+    )
+
+
+def to_json(prog) -> list:
+    """JSON form: one dict per clause, kinds by name — the manifest's
+    `nemesis_clauses` list and the reproducer artifact's `program`."""
+    return [{**dict(zip(FIELDS, c)), "kind": KIND_NAMES[c[0]]}
+            for c in prog]
+
+
+def from_json(doc) -> tuple:
+    """Inverse of `to_json` (also accepts numeric kinds and bare
+    8-lists, so a program pasted from a manifest config dict loads)."""
+    out = []
+    for c in doc:
+        if isinstance(c, dict):
+            kind = c["kind"]
+            kind = KIND_IDS[kind] if isinstance(kind, str) else int(kind)
+            out.append(Clause(kind, *(int(c[f]) for f in FIELDS[1:])))
+        else:
+            out.append(Clause(*(int(x) for x in c)))
+    return tuple(out)
+
+
+def program_hash(prog) -> str:
+    """Stable 8-hex-digit identity of a program — hashed through the
+    repo's own mixer over the flat clause ints (so it is reproducible
+    from the manifest's clause list alone, no JSON canonicalization)."""
+    flat = [len(prog)]
+    for c in prog:
+        flat.extend(int(x) for x in c)
+    return format(_r.hash_u32(*flat), "08x")
+
+
+def describe(prog) -> str:
+    """One human line per clause (search/shrink logs)."""
+    lines = []
+    for c in prog:
+        kind, t0, t1, group_u32, p_u32, a, b, cid = c
+        lines.append(
+            f"#{cid} {KIND_NAMES[kind]} [{t0},{t1}) "
+            f"groups={group_u32 / 2**32:.2f} p={p_u32 / 2**32:.2f} "
+            f"a={a} b={b}")
+    return "; ".join(lines) or "<empty>"

@@ -63,6 +63,13 @@ R12_MANIFEST_KEYS = ("predicted_rounds_per_sec", "attainment_pct",
 # proven equal to obs.manifest.PACKING_KEYS by the auditor.
 R13_MANIFEST_KEYS = ("pack_bools", "pack_ring", "alias_wire", "wire_hist")
 
+# Manifest keys added by the r14 nemesis scenario compiler (the
+# gray-failure program a segment's universe ran under: program hash +
+# clause list) — same present-from-birth / backfilled-as-null contract.
+# Its own literal (the registry idiom), proven equal to
+# obs.manifest.NEMESIS_KEYS by the auditor.
+R14_MANIFEST_KEYS = ("nemesis_program_hash", "nemesis_clauses")
+
 # Manifest records below this group count are smoke/--quick shapes:
 # correctness drives, not trajectory points — a 1K-group quick run's
 # rate joining the 100K series would trip (or mask) the regression
@@ -113,11 +120,11 @@ def _round_of(path: str) -> int | None:
 
 def backfill_record(rec: dict) -> dict:
     """A manifest record normalized to the current schema: the r12
-    roofline/trace keys AND the r13 wire-layout keys present-but-null
-    when the record predates them (same rule as the mesh keys at r08).
-    Returns a new dict."""
+    roofline/trace keys, the r13 wire-layout keys, AND the r14 nemesis
+    keys present-but-null when the record predates them (same rule as
+    the mesh keys at r08). Returns a new dict."""
     out = dict(rec)
-    for k in R12_MANIFEST_KEYS + R13_MANIFEST_KEYS:
+    for k in R12_MANIFEST_KEYS + R13_MANIFEST_KEYS + R14_MANIFEST_KEYS:
         out.setdefault(k, None)
     return out
 

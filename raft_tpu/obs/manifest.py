@@ -66,6 +66,16 @@ ROOFLINE_KEYS = ("predicted_rounds_per_sec", "attainment_pct", "bound",
 # them on read, proven both directions by the auditor's manifest pass).
 PACKING_KEYS = ("pack_bools", "pack_ring", "alias_wire", "wire_hist")
 
+# r14 nemesis keys: which gray-failure program (DESIGN.md §14) the
+# segment's universe ran under — the program's stable hash plus its
+# human/JSON clause list (nemesis.program.to_json), top-level so a
+# reader pairing numbers across fault scenarios never digs through the
+# config dict. Present-but-null from birth (a null = "no nemesis
+# program", which every pre-r14 record trivially satisfies — the same
+# rule as the mesh/roofline/packing keys); obs.history backfills them
+# on read, proven both directions by the auditor's manifest pass.
+NEMESIS_KEYS = ("nemesis_program_hash", "nemesis_clauses")
+
 
 def config_hash(cfg) -> str:
     """Stable short hash of the SEMANTIC config — two runs with equal
@@ -113,7 +123,8 @@ def emit_manifest(segment: str, cfg, device: str | None = None,
            # on one chip" from "device count unrecorded". The r12
            # roofline/trace keys follow the same rule.
            "mesh_shape": None, "groups_per_device": None,
-           **{k: None for k in ROOFLINE_KEYS + PACKING_KEYS}}
+           **{k: None for k in ROOFLINE_KEYS + PACKING_KEYS
+              + NEMESIS_KEYS}}
     rec.update(fields)
     path = path or os.environ.get(MANIFEST_ENV) or DEFAULT_PATH
     if path != "-":

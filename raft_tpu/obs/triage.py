@@ -57,3 +57,59 @@ def bisect_divergence(engine_a, engine_b, st0, n_ticks: int, t0: int = 0,
             "chunk diverged but its tick-by-tick re-execution did not — "
             "an engine is not a deterministic function of (state, t0)")
     return None
+
+
+# ------------------------------------------------- oracle lockstep leg
+
+
+def oracle_trace(cfg, n_groups: int, n_ticks: int):
+    """[T, G, K] int64 numpy trace of the CPU oracle (one `Cluster`
+    per group, ticked in lockstep, `snapshot()` per tick) over
+    `sim.run`'s trace surface plus the aliveness bit — THE oracle-side
+    harness every oracle-vs-batched differential shares
+    (tests/test_differential.py, tests/test_nemesis.py,
+    `kernel_sweep.py --nemesis`), so a change to the trace surface or
+    the snapshot timing convention lands in one place. Returns
+    (field -> array, live clusters)."""
+    import numpy as np
+
+    from raft_tpu.core.cluster import Cluster
+    from raft_tpu.sim.run import TRACE_FIELDS
+
+    fields = TRACE_FIELDS + ("alive",)
+    clusters = [Cluster(cfg, group=g) for g in range(n_groups)]
+    out = {f: np.zeros((n_ticks, n_groups, cfg.k), np.int64)
+           for f in fields}
+    for t in range(n_ticks):
+        for g, c in enumerate(clusters):
+            c.tick()
+            for k, view in enumerate(c.snapshot()):
+                for f in fields:
+                    out[f][t, g, k] = getattr(view, f)
+    return out, clusters
+
+
+def oracle_divergence(cfg, n_groups: int, n_ticks: int,
+                      oracle_groups: int | None = None):
+    """First divergence between the CPU oracle and the XLA scan on the
+    per-node trace surface, or None when lockstep holds. The batched
+    side runs the FULL `n_groups`; the oracle runs the first
+    `oracle_groups` (groups are independent and their identity is the
+    global group id, so the slice is exact). Returns
+    {tick, group, node, field, cpu, jax} on divergence."""
+    import numpy as np
+
+    from raft_tpu import sim
+    from raft_tpu.sim.run import trace
+
+    g_oracle = n_groups if oracle_groups is None \
+        else min(oracle_groups, n_groups)
+    cpu, _ = oracle_trace(cfg, g_oracle, n_ticks)
+    _, jx = trace(cfg, sim.init(cfg, n_groups=n_groups), n_ticks)
+    for f, a in cpu.items():
+        b = np.asarray(jx[f]).astype(np.int64)[:, :g_oracle]
+        if not np.array_equal(a, b):
+            t, g, k = (int(x) for x in np.argwhere(a != b)[0])
+            return {"tick": t, "group": g, "node": k, "field": f,
+                    "cpu": int(a[t, g, k]), "jax": int(b[t, g, k])}
+    return None

@@ -95,11 +95,26 @@ def client_safety(st: State):
     return ok
 
 
-def all_invariants(st: State, log_cap: int):
-    ok = election_safety(st) & digest_agreement(st) & window_bounds(
-        st, log_cap)
+def predicate_report(st: State, log_cap: int) -> dict:
+    """name -> bool[G]: `tick_safety`'s clauses SEPARATELY — the
+    nemesis search (raft_tpu/nemesis/search.py) scores near-misses per
+    predicate and its safety-violation triage names WHICH invariant a
+    state breaks, not just that one did. Key order is stable (report/
+    artifact fields). THE clause registry: `all_invariants` (and hence
+    `tick_safety`) is its AND-reduce, so a predicate added here is
+    automatically folded and nameable — they cannot drift."""
+    out = {"election_safety": election_safety(st),
+           "digest_agreement": digest_agreement(st),
+           "window_bounds": window_bounds(st, log_cap)}
     if st.clients is not None:
-        ok &= client_safety(st)
+        out["client_safety"] = client_safety(st)
+    return out
+
+
+def all_invariants(st: State, log_cap: int):
+    ok = None
+    for v in predicate_report(st, log_cap).values():
+        ok = v if ok is None else ok & v
     return ok
 
 

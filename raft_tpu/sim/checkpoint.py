@@ -147,7 +147,14 @@ def load(path, cfg: Optional[RaftConfig] = None, sharding=None
             # Fields added after the file was written load as their
             # defaults: a pre-r09 universe simply had no such feature,
             # so the default value IS its semantic config (the same
-            # backfill rule as the r07 metrics.safety ones).
+            # backfill rule as the r07 metrics.safety ones). The r14
+            # `nemesis` knob rides this table too — a pre-r14 file
+            # backfills to the empty program, so it resumes under a
+            # nemesis-free cfg and REFUSES under a nemesis-on one
+            # (different universe schedule; the program itself is
+            # list-of-int-lists after the JSON round trip, which
+            # RaftConfig.__post_init__ normalizes back to the hashable
+            # tuple form — proven by the auditor's checkpoint pass).
             defaults = json.loads(json.dumps(
                 dataclasses.asdict(RaftConfig())))
             for k, v in defaults.items():

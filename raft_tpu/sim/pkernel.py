@@ -519,9 +519,13 @@ def _vote_quorum(cfg, ns: PerNode, votes):
 # id ([1, 1] tile under the node vmap).
 
 
-def _reset_timer(cfg, ns: PerNode, g, i, cond):
+def _reset_timer(cfg, ns: PerNode, g, i, cond, t):
     deadline = jrng.election_deadline(cfg.seed, g, i, ns.rng_draws,
                                       cfg.election_min, cfg.election_range)
+    if cfg.nem_skew:
+        # Nemesis clock-skew clauses (DESIGN.md §14; step._reset_timer).
+        deadline = jnp.maximum(1, deadline + jrng.nem_deadline_extra(
+            cfg.seed, cfg.nem_skew, g, i, t))
     return ns._replace(
         election_elapsed=jnp.where(cond, 0, ns.election_elapsed),
         deadline=jnp.where(cond, deadline, ns.deadline),
@@ -565,14 +569,14 @@ def _become_leader(cfg, ns: PerNode, i, cond):
         log_term=_lset(ns.log_term, _slot(cfg, ns.last_index), top, ns.term))
 
 
-def _accept_leader(cfg, ns: PerNode, g, i, src: int, cond):
+def _accept_leader(cfg, ns: PerNode, g, i, src: int, cond, t):
     ns = ns._replace(
         role=jnp.where(cond, FOLLOWER, ns.role),
         leader_id=jnp.where(cond, src, ns.leader_id),
         votes=ns.votes & ~cond,
         leader_elapsed=jnp.where(cond, 0, ns.leader_elapsed),
     )
-    return _reset_timer(cfg, ns, g, i, cond)
+    return _reset_timer(cfg, ns, g, i, cond, t)
 
 
 # ----------------------------------------------------------------- phase D
@@ -590,7 +594,7 @@ def _on_rv_req(cfg, ns, out, g, i, src: int, ib, gl):
              & ((ns.voted_for == NO_VOTE) | (ns.voted_for == src))
              & log_ok)
     ns = ns._replace(voted_for=jnp.where(grant, src, ns.voted_for))
-    ns = _reset_timer(cfg, ns, g, i, grant)
+    ns = _reset_timer(cfg, ns, g, i, grant, gl[2])
     out = out._replace(
         rv_resp_present=_put(out.rv_resp_present, src, present, True),
         rv_resp_term=_put(out.rv_resp_term, src, present, ns.term),
@@ -632,7 +636,7 @@ def _on_ae_req(cfg, ns, out, g, i, src: int, ib, gl):
     ns = _step_down(cfg, ns, m_term, present & (m_term > ns.term))
     stale = present & (m_term < ns.term)
     ok = present & ~stale
-    ns = _accept_leader(cfg, ns, g, i, src, ok)
+    ns = _accept_leader(cfg, ns, g, i, src, ok, gl[2])
 
     past = ok & (m_prev > ns.last_index)
     ct = _term_at(cfg, ns, m_prev)
@@ -746,7 +750,7 @@ def _on_is_req(cfg, ns, out, g, i, src: int, ib, gl):
     ns = _step_down(cfg, ns, m_term, present & (m_term > ns.term))
     stale = present & (m_term < ns.term)
     ok = present & ~stale
-    ns = _accept_leader(cfg, ns, g, i, src, ok)
+    ns = _accept_leader(cfg, ns, g, i, src, ok, gl[2])
     have = ok & (m_si <= ns.commit)
     inst = ok & ~have
     keep = (inst & (m_si <= ns.last_index) & (m_si >= ns.snap_index)
@@ -797,7 +801,7 @@ def _on_is_resp(cfg, ns, out, g, i, src: int, ib, gl):
     return ns._replace(match_index=match_index, next_index=next_index), out
 
 
-def _start_election_masked(cfg, ns, out, g, i, cond):
+def _start_election_masked(cfg, ns, out, g, i, cond, t):
     ns = ns._replace(
         term=jnp.where(cond, ns.term + 1, ns.term),
         role=jnp.where(cond, CANDIDATE, ns.role),
@@ -805,7 +809,7 @@ def _start_election_masked(cfg, ns, out, g, i, cond):
         leader_id=jnp.where(cond, NO_VOTE, ns.leader_id),
         votes=(ns.votes & ~cond) | (cond & (_col(cfg.k) == i)),
     )
-    ns = _reset_timer(cfg, ns, g, i, cond)
+    ns = _reset_timer(cfg, ns, g, i, cond, t)
     won = cond & _vote_quorum(cfg, ns, ns.votes)   # instant single-voter win
     ns = _become_leader(cfg, ns, i, won)
     llt = _last_log_term(cfg, ns)
@@ -859,7 +863,7 @@ def _on_pv_resp(cfg, ns, out, g, i, src: int, ib, gl):
     votes = _krow_or(ns.votes, src, cont)
     ns = ns._replace(votes=votes)
     won_pre = cont & _vote_quorum(cfg, ns, votes)
-    return _start_election_masked(cfg, ns, out, g, i, won_pre)
+    return _start_election_masked(cfg, ns, out, g, i, won_pre, gl[2])
 
 
 def _on_tn_req(cfg, ns, out, g, i, src: int, ib, gl):
@@ -876,7 +880,7 @@ def _on_tn_req(cfg, ns, out, g, i, src: int, ib, gl):
     if cfg.reconfig_u32:
         voters, _ = _current_config(cfg, ns)
         cond = cond & (_bit_at(voters, i, cfg.k) == 1)
-    return _start_election_masked(cfg, ns, out, g, i, cond)
+    return _start_election_masked(cfg, ns, out, g, i, cond, gl[2])
 
 
 _HANDLERS = (_on_rv_req, _on_rv_resp, _on_ae_req, _on_ae_resp,
@@ -969,9 +973,9 @@ def _phase_t(cfg, ns, out, g, i, t):
             leader_id=jnp.where(timeout, NO_VOTE, ns.leader_id),
             votes=(ns.votes & ~timeout) | (timeout & (_col(cfg.k) == i)),
         )
-        ns = _reset_timer(cfg, ns, g, i, timeout)
+        ns = _reset_timer(cfg, ns, g, i, timeout, t)
         skip = timeout & _vote_quorum(cfg, ns, ns.votes)
-        ns, out = _start_election_masked(cfg, ns, out, g, i, skip)
+        ns, out = _start_election_masked(cfg, ns, out, g, i, skip, t)
         llt = _last_log_term(cfg, ns)
         for p in range(cfg.k):
             send = timeout & ~skip & (i != p)
@@ -982,7 +986,7 @@ def _phase_t(cfg, ns, out, g, i, t):
                 pv_req_llt=_put(out.pv_req_llt, p, send, llt),
             )
         return ns, out
-    return _start_election_masked(cfg, ns, out, g, i, timeout)
+    return _start_election_masked(cfg, ns, out, g, i, timeout, t)
 
 
 def _phase_c(cfg, ns, g, t, csub=None, cpay=None):
@@ -1221,12 +1225,15 @@ def _node_tick(cfg, t, ns: PerNode, inbox, g, i, glog_t, glog_p,
 # ------------------------------------------------------------- global tick
 
 
-def _apply_restart(cfg, nodes: PerNode, g, edge):
+def _apply_restart(cfg, nodes: PerNode, g, edge, t):
     """step._apply_restart on [K, 8, 128] leaves (edge: [K, 8, 128])."""
     kio = jax.lax.broadcasted_iota(I32, (cfg.k, 1, 1), 0)
     new_deadline = jrng.election_deadline(cfg.seed, g[None], kio,
                                           nodes.rng_draws, cfg.election_min,
                                           cfg.election_range)
+    if cfg.nem_skew:
+        new_deadline = jnp.maximum(1, new_deadline + jrng.nem_deadline_extra(
+            cfg.seed, cfg.nem_skew, g[None], kio, t))
     e1 = edge[:, None]
     return nodes._replace(
         role=jnp.where(edge, FOLLOWER, nodes.role),
@@ -1266,6 +1273,10 @@ def _filter_mailbox(cfg, mb: Mailbox, t, alive_now, g) -> Mailbox:
     if cfg.drop_u32:
         keep = keep & ~jrng.link_dropped(cfg.seed, gg, t, src, dst,
                                          cfg.drop_u32)
+    if cfg.nem_link:
+        # Nemesis link clauses (DESIGN.md §14; step._filter_mailbox).
+        keep = keep & jrng.nem_link_ok(cfg.seed, cfg.nem_link, gg, t,
+                                       src, dst, cfg.k)
     pv = {}
     if cfg.prevote:
         pv = dict(pv_req_present=mb.pv_req_present & keep,
@@ -1294,7 +1305,13 @@ def _tick(cfg, nodes, mailbox, alive_prev, clients, g, t):
             jrng.node_alive(cfg.seed, g[None], kio, t,
                             cfg.crash_u32, cfg.crash_epoch),
             (cfg.k,) + g.shape)
-    nodes = _apply_restart(cfg, nodes, g, alive_now & ~alive_prev)
+    if cfg.nem_crash:
+        # Nemesis crash-storm clauses AND into the base crash schedule
+        # (DESIGN.md §14; step.tick applies the same mask).
+        alive_now = alive_now & jnp.broadcast_to(
+            jrng.nem_alive(cfg.seed, cfg.nem_crash, g[None], kio, t),
+            (cfg.k,) + g.shape)
+    nodes = _apply_restart(cfg, nodes, g, alive_now & ~alive_prev, t)
     inbox = _filter_mailbox(cfg, mailbox, t, alive_now, g)
 
     csub = cpay = None

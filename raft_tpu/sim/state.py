@@ -231,10 +231,15 @@ def init(cfg: RaftConfig, n_groups: int | None = None) -> State:
     g_idx = jnp.arange(g, dtype=I32)[:, None]          # [G, 1]
     i_idx = jnp.arange(k, dtype=I32)[None, :]          # [1, K]
     # __init__ runs _reset_election_timer once: deadline = draw 0, draws = 1.
-    deadline = jnp.broadcast_to(
-        jrng.election_deadline(cfg.seed, g_idx, i_idx, 0,
-                               cfg.election_min, cfg.election_range),
-        (g, k))
+    deadline = jrng.election_deadline(cfg.seed, g_idx, i_idx, 0,
+                                      cfg.election_min, cfg.election_range)
+    if cfg.nem_skew:
+        # The initial draw happens "at" tick 0 on every engine — a
+        # nemesis clock-skew span covering tick 0 skews it (DESIGN.md
+        # §14), exactly like Node.__init__'s reset with now == 0.
+        deadline = jnp.maximum(1, deadline + jrng.nem_deadline_extra(
+            cfg.seed, cfg.nem_skew, g_idx, i_idx, 0))
+    deadline = jnp.broadcast_to(deadline, (g, k))
 
     def z(dtype, *extra):
         return jnp.zeros((g, k) + extra, dtype)

@@ -154,10 +154,16 @@ def _vote_quorum(cfg, ns: PerNode, votes):
 # -------------------------------------------------------------- transitions
 
 
-def _reset_timer(cfg, ns: PerNode, g, i, cond):
-    """`Node._reset_election_timer` (node.py:89): one counted draw."""
+def _reset_timer(cfg, ns: PerNode, g, i, cond, t):
+    """`Node._reset_election_timer` (node.py:89): one counted draw.
+    `t` is the absolute tick of the draw — consumed only by the
+    statically-gated nemesis clock-skew clauses (DESIGN.md §14), so
+    the skew-off program is unchanged."""
     deadline = jrng.election_deadline(cfg.seed, g, i, ns.rng_draws,
                                       cfg.election_min, cfg.election_range)
+    if cfg.nem_skew:
+        deadline = jnp.maximum(1, deadline + jrng.nem_deadline_extra(
+            cfg.seed, cfg.nem_skew, g, i, t))
     return ns._replace(
         election_elapsed=jnp.where(cond, 0, ns.election_elapsed),
         deadline=jnp.where(cond, deadline, ns.deadline),
@@ -206,7 +212,7 @@ def _become_leader(cfg, ns: PerNode, i, cond):
         log_term=_lset(ns.log_term, _slot(cfg, ns.last_index), top, ns.term))
 
 
-def _accept_leader(cfg, ns: PerNode, g, i, src: int, cond):
+def _accept_leader(cfg, ns: PerNode, g, i, src: int, cond, t):
     """`Node._accept_leader` (node.py:194)."""
     ns = ns._replace(
         role=jnp.where(cond, FOLLOWER, ns.role),
@@ -214,7 +220,7 @@ def _accept_leader(cfg, ns: PerNode, g, i, src: int, cond):
         votes=jnp.where(cond, False, ns.votes),
         leader_elapsed=jnp.where(cond, 0, ns.leader_elapsed),
     )
-    return _reset_timer(cfg, ns, g, i, cond)
+    return _reset_timer(cfg, ns, g, i, cond, t)
 
 
 # ----------------------------------------------------------------- phase D
@@ -232,7 +238,7 @@ def _on_rv_req(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
              & ((ns.voted_for == NO_VOTE) | (ns.voted_for == src))
              & log_ok)
     ns = ns._replace(voted_for=jnp.where(grant, src, ns.voted_for))
-    ns = _reset_timer(cfg, ns, g, i, grant)
+    ns = _reset_timer(cfg, ns, g, i, grant, gl[2])
     out = out._replace(
         rv_resp_present=_put(out.rv_resp_present, src, present, True),
         rv_resp_term=_put(out.rv_resp_term, src, present, ns.term),
@@ -282,7 +288,7 @@ def _on_ae_req(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
     ns = _step_down(cfg, ns, m_term, present & (m_term > ns.term))
     stale = present & (m_term < ns.term)
     ok = present & ~stale
-    ns = _accept_leader(cfg, ns, g, i, src, ok)
+    ns = _accept_leader(cfg, ns, g, i, src, ok, gl[2])
 
     past = ok & (m_prev > ns.last_index)
     conflict = (ok & ~past & (m_prev >= ns.snap_index)
@@ -415,7 +421,7 @@ def _on_is_req(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
     ns = _step_down(cfg, ns, m_term, present & (m_term > ns.term))
     stale = present & (m_term < ns.term)
     ok = present & ~stale
-    ns = _accept_leader(cfg, ns, g, i, src, ok)
+    ns = _accept_leader(cfg, ns, g, i, src, ok, gl[2])
     have = ok & (m_si <= ns.commit)   # already covered (node.py:283)
     inst = ok & ~have
     # Keep-the-suffix test (node.py:288-293). In the ring model keeping the
@@ -471,7 +477,7 @@ def _on_is_resp(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
     return ns._replace(match_index=match_index, next_index=next_index), out
 
 
-def _start_election_masked(cfg, ns, out, g, i, cond):
+def _start_election_masked(cfg, ns, out, g, i, cond, t):
     """`Node._start_election` under a mask: term bump, candidacy, fresh
     timer draw, instant single-voter win, RequestVote broadcast. Shared
     by the pre-vote quorum path (phase D) and phase T's skip case."""
@@ -482,7 +488,7 @@ def _start_election_masked(cfg, ns, out, g, i, cond):
         leader_id=jnp.where(cond, NO_VOTE, ns.leader_id),
         votes=jnp.where(cond, jnp.arange(cfg.k) == i, ns.votes),
     )
-    ns = _reset_timer(cfg, ns, g, i, cond)
+    ns = _reset_timer(cfg, ns, g, i, cond, t)
     won = cond & _vote_quorum(cfg, ns, ns.votes)   # instant single-voter win
     ns = _become_leader(cfg, ns, i, won)
     llt = _last_log_term(cfg, ns)
@@ -536,7 +542,7 @@ def _on_pv_resp(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
     votes = ns.votes.at[src].set(ns.votes[src] | cont)
     ns = ns._replace(votes=votes)
     won_pre = cont & _vote_quorum(cfg, ns, votes)
-    return _start_election_masked(cfg, ns, out, g, i, won_pre)
+    return _start_election_masked(cfg, ns, out, g, i, won_pre, gl[2])
 
 
 def _on_tn_req(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
@@ -555,7 +561,7 @@ def _on_tn_req(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
     if cfg.reconfig_u32:
         voters, _ = _current_config(cfg, ns)
         cond = cond & (((voters >> i) & 1) == 1)
-    return _start_election_masked(cfg, ns, out, g, i, cond)
+    return _start_election_masked(cfg, ns, out, g, i, cond, gl[2])
 
 
 _HANDLERS = (_on_rv_req, _on_rv_resp, _on_ae_req, _on_ae_resp,
@@ -660,9 +666,9 @@ def _phase_t(cfg, ns, out, g, i, t):
             leader_id=jnp.where(timeout, NO_VOTE, ns.leader_id),
             votes=jnp.where(timeout, jnp.arange(cfg.k) == i, ns.votes),
         )
-        ns = _reset_timer(cfg, ns, g, i, timeout)
+        ns = _reset_timer(cfg, ns, g, i, timeout, t)
         skip = timeout & _vote_quorum(cfg, ns, ns.votes)
-        ns, out = _start_election_masked(cfg, ns, out, g, i, skip)
+        ns, out = _start_election_masked(cfg, ns, out, g, i, skip, t)
         llt = _last_log_term(cfg, ns)
         for p in range(cfg.k):
             send = timeout & ~skip & (i != p)
@@ -673,7 +679,7 @@ def _phase_t(cfg, ns, out, g, i, t):
                 pv_req_llt=_put(out.pv_req_llt, p, send, llt),
             )
         return ns, out
-    return _start_election_masked(cfg, ns, out, g, i, timeout)
+    return _start_election_masked(cfg, ns, out, g, i, timeout, t)
 
 
 # ----------------------------------------------------------------- phase C
@@ -892,11 +898,15 @@ def _node_tick(cfg, t, ns: PerNode, inbox: Mailbox, g, i, glog_t, glog_p,
 # ------------------------------------------------------------- global tick
 
 
-def _apply_restart(cfg, nodes: PerNode, g_grid, i_grid, edge):
-    """`Node.restart` (node.py:139): durable survives, volatile rewinds."""
+def _apply_restart(cfg, nodes: PerNode, g_grid, i_grid, edge, t):
+    """`Node.restart` (node.py:139): durable survives, volatile rewinds.
+    `t` feeds only the statically-gated nemesis clock-skew clauses."""
     new_deadline = jrng.election_deadline(cfg.seed, g_grid, i_grid,
                                           nodes.rng_draws, cfg.election_min,
                                           cfg.election_range)
+    if cfg.nem_skew:
+        new_deadline = jnp.maximum(1, new_deadline + jrng.nem_deadline_extra(
+            cfg.seed, cfg.nem_skew, g_grid, i_grid, t))
     e1 = edge[..., None]
     return nodes._replace(
         role=jnp.where(edge, FOLLOWER, nodes.role),
@@ -938,6 +948,11 @@ def _filter_mailbox(cfg, mb: Mailbox, t, alive_now, group_id) -> Mailbox:
                                  cfg.partition_u32, cfg.partition_epoch)
     drop = jrng.link_dropped(cfg.seed, gg, t, src, dst, cfg.drop_u32)
     keep = alive_now[:, :, None] & ~part & ~drop
+    if cfg.nem_link:
+        # Nemesis link clauses (DESIGN.md §14) AND into the same
+        # delivery filter as the base drop/partition schedules.
+        keep = keep & jrng.nem_link_ok(cfg.seed, cfg.nem_link, gg, t,
+                                       src, dst, cfg.k)
     pv = {}
     if mb.pv_req_present is not None:
         pv = dict(pv_req_present=mb.pv_req_present & keep,
@@ -967,8 +982,13 @@ def tick(cfg: RaftConfig, st: State, t) -> State:
     alive_now = jnp.broadcast_to(
         jrng.node_alive(cfg.seed, g_grid, i_grid, t,
                         cfg.crash_u32, cfg.crash_epoch), (g, k))
+    if cfg.nem_crash:
+        # Nemesis crash-storm clauses AND into the base crash schedule
+        # (a node is up only when BOTH schedules say so).
+        alive_now = alive_now & jrng.nem_alive(cfg.seed, cfg.nem_crash,
+                                               g_grid, i_grid, t)
     nodes = _apply_restart(cfg, st.nodes, g_grid, i_grid,
-                           alive_now & ~st.alive_prev)
+                           alive_now & ~st.alive_prev, t)
 
     # The mailbox lives in [G, dst, src, ...] layout: that is what the
     # per-node slice consumes directly (each node sees its per-sender

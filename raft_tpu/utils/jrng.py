@@ -118,3 +118,113 @@ def client_val(seed, g, sid, seq):
 
 def digest_update(digest, index, payload):
     return mix32(_u32(digest) * _GOLD + mix32(_u32(index) * _GOLD + _u32(payload)))
+
+
+# ------------------------------------------- compiled nemesis evaluators
+# u32-lane twins of utils.rng's nemesis evaluators (DESIGN.md §14),
+# bit-identical by construction and pinned by tests/test_nemesis.py.
+# `prog` is a STATIC tuple of 8-int clauses (the python loop unrolls at
+# trace time, exactly like the K-unrolled handlers); every per-lane
+# value derives from hash compares on runtime coordinates, so the masks
+# are Mosaic-legal inside the Pallas kernel (no i1 constants). The
+# bodies are elementwise-only — one implementation serves the XLA
+# [G, ...] layouts and the kernel [.., 8, 128] tiles, enforced by the
+# analysis linter's elementwise rule over these functions.
+
+
+def _nem_active(seed, c, g, t):
+    """One clause's span ∧ per-group participation gate (broadcast)."""
+    _, t0, t1, group_u32, _, _, _, cid = c
+    span = (jnp.asarray(t) >= t0) & (jnp.asarray(t) < t1)
+    return span & (hash_u32(seed, _r.TAG_NEM_GROUP, cid, g)
+                   < jnp.uint32(group_u32))
+
+
+def nem_link_ok(seed, prog, g, t, src, dst, k: int):
+    relevant = False
+    blocked = None
+    for c in prog:
+        kind, t0, t1, group_u32, p_u32, a, b, cid = c
+        if kind not in _r.NEM_LINK_KINDS:
+            continue
+        # Relevance is established BEFORE the static per-kind no-op
+        # skips below, so a link program whose clauses are all no-ops
+        # (e.g. a flaky link in a k=1 group) stays legal on every
+        # engine, exactly like utils.rng's host evaluator.
+        relevant = True
+        if kind == _r.NEM_SLOW:
+            target = hash_u32(seed, _r.TAG_NEM_NODE, cid, g) % jnp.uint32(k)
+            hit = None
+            if a & 1:
+                hit = _u32(src) == target
+            if a & 2:
+                h2 = _u32(dst) == target
+                hit = h2 if hit is None else hit | h2
+            if hit is None:
+                continue   # direction mask 0: statically a no-op
+        elif kind == _r.NEM_FLAKY:
+            if k < 2:
+                continue   # a 1-node group has no links
+            s = hash_u32(seed, _r.TAG_NEM_NODE, cid, g, 0) % jnp.uint32(k)
+            d = (s + jnp.uint32(1)
+                 + hash_u32(seed, _r.TAG_NEM_NODE, cid, g, 1)
+                 % jnp.uint32(k - 1)) % jnp.uint32(k)
+            burst = hash_u32(seed, _r.TAG_NEM_BURST, cid, g,
+                             _u32(t) // jnp.uint32(a)) < jnp.uint32(b)
+            hit = (_u32(src) == s) & (_u32(dst) == d) & burst
+        elif kind == _r.NEM_WAN:
+            hit = (hash_u32(seed, _r.TAG_NEM_NODE, cid, g, src)
+                   % jnp.uint32(a)
+                   != hash_u32(seed, _r.TAG_NEM_NODE, cid, g, dst)
+                   % jnp.uint32(a))
+        else:   # NEM_WAVE
+            wave = ((_u32(t) + _u32(g)) % jnp.uint32(a)) < jnp.uint32(b)
+            ep = _u32(t) // jnp.uint32(a)
+            hit = wave & (
+                (hash_u32(seed, _r.TAG_NEM_SIDE, cid, g, ep, src)
+                 & jnp.uint32(1))
+                != (hash_u32(seed, _r.TAG_NEM_SIDE, cid, g, ep, dst)
+                    & jnp.uint32(1)))
+        drop = (_nem_active(seed, c, g, t) & hit
+                & (hash_u32(seed, _r.TAG_NEM_LINK, cid, g, t, src, dst)
+                   < jnp.uint32(p_u32)))
+        blocked = drop if blocked is None else blocked | drop
+    if not relevant:
+        raise ValueError("nem_link_ok: no link clause in the program — "
+                         "gate the call on cfg.nem_link")
+    if blocked is None:
+        return jnp.bool_(True)   # every link clause statically a no-op
+    return jnp.logical_not(blocked)
+
+
+def nem_alive(seed, prog, g, i, t):
+    dead = None
+    for c in prog:
+        kind, t0, t1, group_u32, p_u32, a, b, cid = c
+        if kind not in _r.NEM_CRASH_KINDS:
+            continue
+        down = (_nem_active(seed, c, g, t)
+                & (hash_u32(seed, _r.TAG_NEM_CRASH, cid, g, i,
+                            _u32(t) // jnp.uint32(a)) < jnp.uint32(p_u32)))
+        dead = down if dead is None else dead | down
+    if dead is None:
+        raise ValueError("nem_alive: no crash clause in the program — "
+                         "gate the call on cfg.nem_crash")
+    return jnp.logical_not(dead)
+
+
+def nem_deadline_extra(seed, prog, g, i, t):
+    extra = None
+    for c in prog:
+        kind, t0, t1, group_u32, p_u32, a, b, cid = c
+        if kind not in _r.NEM_TIMING_KINDS:
+            continue
+        act = (_nem_active(seed, c, g, t)
+               & (hash_u32(seed, _r.TAG_NEM_NODE, cid, g, i)
+                  < jnp.uint32(p_u32)))
+        term = jnp.where(act, jnp.int32(a), jnp.int32(0))
+        extra = term if extra is None else extra + term
+    if extra is None:
+        raise ValueError("nem_deadline_extra: no timing clause in the "
+                         "program — gate the call on cfg.nem_skew")
+    return extra

@@ -30,6 +30,67 @@ TAG_TRANSFER = 9       # per-group per-epoch leadership-transfer attempt?
 TAG_TRANSFER_NODE = 10  # which node the transfer hands leadership to
 TAG_CLIENT_ARRIVAL = 11  # per-(group, sid) per-tick client-op arrival?
 TAG_CLIENT_VAL = 12      # the 10-bit value hash of client op (sid, seq)
+# Nemesis scenario compiler (DESIGN.md §14): every gray-failure clause
+# compiles to draws under these tags, domain-separated per clause by
+# its cid so dropping one clause never reshuffles another's schedule
+# (the property the auto-shrinker's monotone minimization rests on).
+TAG_NEM_GROUP = 13   # per-clause per-group participation
+TAG_NEM_NODE = 14    # per-clause node / link-endpoint / site selection
+TAG_NEM_LINK = 15    # per-clause per-link per-tick delivery draw
+TAG_NEM_CRASH = 16   # crash-storm epoch draws
+TAG_NEM_SIDE = 17    # partition-wave side assignment (per period)
+TAG_NEM_BURST = 18   # flaky-link burst-epoch draws
+
+
+# ------------------------------------------------------ nemesis programs
+# A nemesis program is a static tuple of 8-int clauses
+#     (kind, t0, t1, group_u32, p_u32, a, b, cid)
+# built by raft_tpu/nemesis/program.py and carried in
+# RaftConfig.nemesis. The clause kinds and their compiled elementwise
+# semantics live HERE (with bit-identical jrng twins) because this
+# module is the repo's one source of schedule randomness: a clause is
+# nothing but a pure (seed, TAG_NEM_*, cid, coords) hash family gating
+# the same three seams the config-4/5 fault mix already uses — the
+# delivery filter, the aliveness mask, and the election-deadline draw.
+#
+# Kind-specific meaning of (p_u32, a, b):
+#   NEM_SLOW   slow-but-alive follower: links touching the hash-chosen
+#              target node drop w.p. p_u32 per tick; a = direction mask
+#              (1 = from the target, 2 = to it, 3 = both); b unused.
+#   NEM_FLAKY  asymmetric flaky link: ONE hash-chosen ordered pair
+#              (s -> d) drops w.p. p_u32, but only inside bursts —
+#              sub-epochs of a ticks firing w.p. b (a u32 threshold).
+#   NEM_WAN    heterogeneous WAN delivery: nodes hash onto a sites;
+#              cross-site links drop w.p. p_u32 per tick (in a
+#              tick-synchronous world with heartbeat retransmission, a
+#              d-tick link delay IS a geometric redelivery — loss with
+#              retry — which is how latency compiles to this form).
+#   NEM_SKEW   timeout/clock skew: nodes selected w.p. p_u32 add the
+#              SIGNED a to every election-deadline draw made during
+#              the span (deadline clamps at 1); b unused.
+#   NEM_STORM  crash-recovery storm: per (node, sub-epoch of a ticks)
+#              the node is down w.p. p_u32 — a second, faster crash
+#              schedule ANDed into the base one; b unused.
+#   NEM_WAVE   correlated partition wave: a partition window of b
+#              ticks sweeps the fleet with period a (group g enters it
+#              g ticks after g-1); inside the window cross-side links
+#              (sides re-drawn each period) drop w.p. p_u32 — p_u32
+#              below 1.0 is a leaky, gray partition.
+NEM_SLOW = 1
+NEM_FLAKY = 2
+NEM_WAN = 3
+NEM_SKEW = 4
+NEM_STORM = 5
+NEM_WAVE = 6
+NEM_KINDS = (NEM_SLOW, NEM_FLAKY, NEM_WAN, NEM_SKEW, NEM_STORM, NEM_WAVE)
+# Which seam each kind compiles onto — RaftConfig.nem_link / nem_crash
+# / nem_skew filter by these, and the engines statically gate each seam
+# on its filtered subprogram being non-empty. Every kind MUST appear in
+# exactly one tuple (analysis.contracts.nemesis_problems proves the
+# partition, so a new kind cannot be silently ignored by every seam).
+NEM_LINK_KINDS = (NEM_SLOW, NEM_FLAKY, NEM_WAN, NEM_WAVE)
+NEM_CRASH_KINDS = (NEM_STORM,)
+NEM_TIMING_KINDS = (NEM_SKEW,)
 
 
 def mix32(x: int) -> int:
@@ -121,3 +182,98 @@ def client_val(seed: int, g: int, sid: int, seq: int) -> int:
 def digest_update(digest: int, index: int, payload: int) -> int:
     """State-machine hash chain: apply entry `index` with `payload`."""
     return mix32((digest * GOLD + mix32((index * GOLD + payload) & _U32)) & _U32)
+
+
+# ------------------------------------------- compiled nemesis evaluators
+# Host-int reference implementations; utils/jrng.py carries the
+# bit-identical u32-lane twins (tests/test_nemesis.py pins the parity
+# on coordinate grids, like every other schedule pair). Callers pass
+# the kind-FILTERED subprogram (RaftConfig.nem_link / nem_crash /
+# nem_skew) and statically gate the call on it being non-empty — an
+# evaluator that finds no relevant clause raises, so a mis-filtered
+# program fails at trace/build time, never as a silent no-op.
+
+
+def _nem_active(seed: int, c: tuple, g: int, t: int) -> bool:
+    """One clause's span ∧ per-group participation gate."""
+    _, t0, t1, group_u32, _, _, _, cid = c
+    return (t0 <= t < t1
+            and hash_u32(seed, TAG_NEM_GROUP, cid, g) < group_u32)
+
+
+def nem_link_ok(seed, prog, g, t, src, dst, k):
+    """True iff no active link clause blocks delivery on (src -> dst)
+    at tick t — ANDed into the same delivery filter as drop/partition."""
+    relevant = False
+    ok = True
+    for c in prog:
+        kind, t0, t1, group_u32, p_u32, a, b, cid = c
+        if kind not in NEM_LINK_KINDS:
+            continue
+        relevant = True
+        if not _nem_active(seed, c, g, t):
+            continue
+        if kind == NEM_SLOW:
+            target = hash_u32(seed, TAG_NEM_NODE, cid, g) % k
+            hit = (((a & 1) != 0 and src == target)
+                   or ((a & 2) != 0 and dst == target))
+        elif kind == NEM_FLAKY:
+            if k < 2:
+                continue   # a 1-node group has no links
+            s = hash_u32(seed, TAG_NEM_NODE, cid, g, 0) % k
+            d = (s + 1 + hash_u32(seed, TAG_NEM_NODE, cid, g, 1)
+                 % (k - 1)) % k
+            hit = (src == s and dst == d
+                   and hash_u32(seed, TAG_NEM_BURST, cid, g, t // a) < b)
+        elif kind == NEM_WAN:
+            hit = (hash_u32(seed, TAG_NEM_NODE, cid, g, src) % a
+                   != hash_u32(seed, TAG_NEM_NODE, cid, g, dst) % a)
+        else:   # NEM_WAVE
+            wave = ((t + g) % a) < b
+            hit = (wave
+                   and (hash_u32(seed, TAG_NEM_SIDE, cid, g, t // a, src) & 1)
+                   != (hash_u32(seed, TAG_NEM_SIDE, cid, g, t // a, dst) & 1))
+        if hit and hash_u32(seed, TAG_NEM_LINK, cid, g, t, src, dst) < p_u32:
+            ok = False
+    if not relevant:
+        raise ValueError("nem_link_ok: no link clause in the program — "
+                         "gate the call on cfg.nem_link")
+    return ok
+
+
+def nem_alive(seed, prog, g, i, t):
+    """True iff no active crash-storm clause holds node i down at tick
+    t — ANDed into the base TAG_CRASH aliveness mask."""
+    relevant = False
+    alive = True
+    for c in prog:
+        kind, t0, t1, group_u32, p_u32, a, b, cid = c
+        if kind not in NEM_CRASH_KINDS:
+            continue
+        relevant = True
+        if (_nem_active(seed, c, g, t)
+                and hash_u32(seed, TAG_NEM_CRASH, cid, g, i, t // a) < p_u32):
+            alive = False
+    if not relevant:
+        raise ValueError("nem_alive: no crash clause in the program — "
+                         "gate the call on cfg.nem_crash")
+    return alive
+
+
+def nem_deadline_extra(seed, prog, g, i, t):
+    """Signed tick skew added to the election-deadline draw node i
+    makes at tick t (callers clamp the skewed deadline at 1)."""
+    relevant = False
+    extra = 0
+    for c in prog:
+        kind, t0, t1, group_u32, p_u32, a, b, cid = c
+        if kind not in NEM_TIMING_KINDS:
+            continue
+        relevant = True
+        if (_nem_active(seed, c, g, t)
+                and hash_u32(seed, TAG_NEM_NODE, cid, g, i) < p_u32):
+            extra += a
+    if not relevant:
+        raise ValueError("nem_deadline_extra: no timing clause in the "
+                         "program — gate the call on cfg.nem_skew")
+    return extra

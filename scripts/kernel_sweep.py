@@ -156,6 +156,56 @@ def run_universe(cfg: RaftConfig, n_groups: int, ticks: int,
             f"exactly-once: {eo_why or 'ok'}", dt, unsafe)
 
 
+def nemesis_cell(base_seed: int, n_groups: int, ticks: int,
+                 interpret: bool, devices: int = 1) -> int:
+    """The --nemesis smoke cell (ISSUE r14): ONE canonical gray-failure
+    program (`nemesis.gray_mix` — slow-but-alive follower + asymmetric
+    flaky link) through ALL THREE engines over a faulted universe:
+
+    - CPU oracle vs the XLA scan, lockstep on the trace surface per
+      node per tick (the first min(8, G) groups — groups are
+      independent and identity is the global group id, so the oracle
+      slice of a larger batched run is exact);
+    - XLA scan vs the Pallas kernel (sharded when --devices > 1) on the
+      FULL State + Metrics pytrees, bit-identical.
+
+    rc != 0 on any divergence or safety violation."""
+    from raft_tpu import nemesis
+    from raft_tpu.obs.triage import oracle_divergence
+
+    ticks = max(ticks, 120)   # the acceptance gate is a >=120-tick soak
+    cfg = RaftConfig(seed=base_seed, k=3, log_cap=8, compact_every=4,
+                     drop_prob=0.03, crash_prob=0.1, crash_epoch=24,
+                     nemesis=nemesis.gray_mix(ticks))
+    print(f"[nemesis] program {nemesis.program_hash(cfg.nemesis)}: "
+          f"{nemesis.describe(cfg.nemesis)}", flush=True)
+
+    t0 = time.perf_counter()
+    g_oracle = min(8, n_groups)
+    div = oracle_divergence(cfg, n_groups, ticks, oracle_groups=g_oracle)
+    if div is not None:
+        print(f"[nemesis] ORACLE vs XLA DIVERGED at t={div['tick']} "
+              f"group={div['group']} node={div['node']} "
+              f"field={div['field']}: cpu={div['cpu']} jax={div['jax']}",
+              flush=True)
+        return 1
+    print(f"[nemesis] oracle == xla per node per tick "
+          f"({g_oracle} groups x {ticks} ticks)", flush=True)
+
+    ok, detail, dt, unsafe = run_universe(cfg, n_groups, ticks, interpret,
+                                          devices)
+    tag = "ok" if ok else "DIVERGED"
+    safe_tag = "ok" if unsafe == 0 else f"VIOLATED({unsafe} groups)"
+    print(f"[nemesis] xla vs kernel: {tag} safety={safe_tag} — {detail} "
+          f"({time.perf_counter() - t0:.1f}s total)", flush=True)
+    if ok and unsafe == 0:
+        print(f"[nemesis] gray-failure program bit-identical on "
+              f"oracle/xla/kernel over {n_groups} groups x {ticks} "
+              f"ticks", file=sys.stderr)
+        return 0
+    return 1
+
+
 def _reexec_with_host_devices(n_devices: int) -> int:
     """Re-run this script in a child whose env forces an n-device
     virtual CPU platform BEFORE jax initializes (the flag is read at
@@ -193,6 +243,12 @@ def main():
                     "packed + donated wire (pack_bools + pack_ring + "
                     "alias_wire) — packed x feature x fault pairwise "
                     "cells, same full State+Metrics bit-identity gate")
+    ap.add_argument("--nemesis", action="store_true",
+                    help="run the r14 gray-failure smoke cell instead "
+                    "of the pairwise matrix: ONE canonical nemesis "
+                    "program (slow-follower + flaky-link mix) through "
+                    "oracle, XLA, and the kernel over a >=120-tick "
+                    "faulted universe; rc != 0 on any divergence")
     args = ap.parse_args()
     _check_pairwise(ROWS)
 
@@ -231,6 +287,10 @@ def main():
         print("no TPU attached: pass --interpret (and a small "
               "--groups/--ticks) for a CPU smoke", file=sys.stderr)
         return 2
+
+    if args.nemesis:
+        return nemesis_cell(args.seed, args.groups, args.ticks,
+                            args.interpret, args.devices)
 
     failures = violations = swept = 0
     for n, cfg in enumerate(sweep_configs(args.seed, args.clients,

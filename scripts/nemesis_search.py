@@ -1,0 +1,217 @@
+"""Coverage-guided adversarial nemesis search (DESIGN.md §14).
+
+Mutates gray-failure programs (raft_tpu/nemesis) over a faulted base
+universe, scores each candidate run by safety-fold near-misses and
+flight-ring health, and keeps a corpus of coverage-novel programs. Any
+candidate that actually drops the per-tick safety bit is auto-shrunk
+(clause drops + span halvings, `obs.triage`-style violation naming) to
+a minimal reproducer and serialized as a self-contained JSON artifact.
+
+The whole hunt is deterministic in --seed: mutation choices are
+hash_u32 draws, so a violation found on one box replays everywhere.
+Each distinct program is a distinct static config (one XLA compile per
+candidate) — size --groups/--ticks like a test, not a bench.
+
+    # hunt (rc 3 + artifact on a violation; rc 0 on a clean budget):
+    python scripts/nemesis_search.py --groups 16 --ticks 64 --budget 24
+    # replay a checked-in reproducer (rc 1 if it stopped reproducing
+    # or names a different tick/leaf):
+    python scripts/nemesis_search.py --replay NEMESIS_repro_example.json
+    # cross-engine check of the best program found (interpret-mode
+    # Pallas vs the XLA scan; any divergence is bisected + shrunk):
+    python scripts/nemesis_search.py --budget 12 --check-kernel
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # runnable as `python scripts/...`
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.nemesis import describe, program_hash
+from raft_tpu.nemesis import search as nsearch
+
+
+def log(msg: str):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def base_config(seed: int) -> RaftConfig:
+    """The search's base universe: light always-on churn (so nemesis
+    clauses compose with a live fault background), small ring."""
+    return RaftConfig(seed=seed, k=3, log_cap=8, compact_every=4,
+                      drop_prob=0.03, crash_prob=0.1, crash_epoch=24)
+
+
+def _xla_vs_kernel_pair(cfg):
+    """Engine pair for the cross-engine differential: the XLA scan vs
+    the Pallas kernel in interpret mode (runs on any box)."""
+    from raft_tpu.sim import pkernel
+    from raft_tpu.sim.run import run
+
+    def xla(s, n, t):
+        return run(cfg, s, n, t)[0]
+
+    def kernel(s, n, t):
+        return pkernel.prun(cfg, s, n, t, interpret=True)[0]
+    return xla, kernel
+
+
+def replay(path: str, n_groups: int) -> int:
+    cfg, artifact = nsearch.load_reproducer(path)
+    n_ticks = artifact["n_ticks"]
+    # The artifact's own run shape wins — the violating group must
+    # exist in the replay universe (--groups is only the fallback for
+    # pre-n_groups artifacts).
+    n_groups = artifact.get("n_groups") or n_groups
+    log(f"replaying {path}: {len(cfg.nemesis)} clause(s), "
+        f"program {artifact['program_hash']}, engines "
+        f"{artifact['engines']!r}, expecting tick "
+        f"{artifact['violation']['tick']} leaf "
+        f"{artifact['violation']['leaf']!r}")
+    inject = artifact.get("inject")
+    if inject is not None:
+        # A SEEDED violation (--seed-violation / the checked-in
+        # example): rebuild the corrupting engine from the recorded
+        # parameters and bisect it against the clean scan.
+        pair = nsearch.term_corruption_pair(
+            inject["tick"], inject["group"], inject["node"],
+            inject.get("bump", 4))   # the signature default — a +1
+        # fallback could be absorbed by term monotonicity and fail a
+        # healthy reproducer
+        repro = nsearch.divergence_repro(cfg, pair, n_groups, n_ticks)
+    elif artifact["engines"] == "xla-vs-pallas-interpret":
+        repro = nsearch.divergence_repro(cfg, _xla_vs_kernel_pair,
+                                         n_groups, n_ticks)
+    else:
+        repro = nsearch.safety_repro(cfg, n_groups, n_ticks)
+    try:
+        rep = nsearch.verify_reproducer(artifact, repro)
+    except AssertionError as e:
+        log(f"REPLAY FAILED: {e}")
+        return 1
+    log(f"replay ok: tick {rep['tick']} — {rep['leaf_report']}")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--groups", type=int, default=16)
+    ap.add_argument("--ticks", type=int, default=64)
+    ap.add_argument("--budget", type=int, default=24,
+                    help="mutate-run-score steps (one XLA compile each)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="search seed (mutations AND the base universe)")
+    ap.add_argument("--out", default="NEMESIS_repro.json",
+                    help="where a shrunk violation artifact is written")
+    ap.add_argument("--replay", default=None, metavar="ARTIFACT",
+                    help="replay a reproducer artifact instead of "
+                         "searching (rc 1 on drift)")
+    ap.add_argument("--check-kernel", action="store_true",
+                    help="after the hunt, run the best program through "
+                         "the interpret-mode Pallas kernel and bisect "
+                         "any divergence from the XLA scan (slow)")
+    ap.add_argument("--seed-violation", type=int, default=None,
+                    metavar="TICK",
+                    help="skip the hunt: inject a known safety "
+                         "violation (term flip at TICK, armed only "
+                         "while a nemesis clause is active) under the "
+                         "canonical gray mix, shrink it, write the "
+                         "artifact, and verify it replays — the "
+                         "end-to-end self-test of the shrink loop")
+    args = ap.parse_args()
+
+    # Pre-flight contract audit (the bench/sweep rule): a hunt over a
+    # drifted layout would chase ghosts.
+    from raft_tpu import analysis
+    analysis.startup_audit(level="static", log=log)
+
+    if args.replay:
+        return replay(args.replay, args.groups)
+
+    base = base_config(args.seed)
+    if args.seed_violation is not None:
+        from raft_tpu.nemesis import gray_mix
+        t = args.seed_violation
+        prog = gray_mix(args.ticks)
+        pair = nsearch.term_corruption_pair(t)
+        # chunk=1 keeps the whole shrink on ONE compiled program per
+        # candidate config (see term_corruption_pair) — the shrink
+        # loop's wall time is XLA compiles, not tick execution.
+        repro = nsearch.divergence_repro(base, pair, args.groups,
+                                         args.ticks, chunk=1)
+        log(f"seeded violation: term flip at tick {t} (armed under the "
+            f"program) over {describe(prog)} — shrinking")
+        mini, rep = nsearch.shrink(prog, repro, log=log)
+        cfg_min = dataclasses.replace(base, nemesis=mini)
+        artifact = nsearch.reproducer(
+            cfg_min, args.ticks, rep, engines="xla-vs-seeded-corruption",
+            inject={"kind": "term_flip", "tick": t, "group": 0,
+                    "node": 1, "bump": 4},
+            n_groups=args.groups,
+            note=f"seeded self-test: nemesis_search --seed-violation {t} "
+                 f"--seed {args.seed}")
+        nsearch.save_reproducer(args.out, artifact)
+        log(f"minimal reproducer ({len(mini)} clause(s), program "
+            f"{program_hash(mini)}) -> {args.out}: tick {rep['tick']} "
+            f"leaf {rep['leaf']}")
+        nsearch.verify_reproducer(artifact, repro)
+        log("replay verified: same tick + leaf")
+        return 0
+    log(f"hunting: {args.groups} groups x {args.ticks} ticks per "
+        f"candidate, budget {args.budget}, seed {args.seed}")
+    res = nsearch.search(base, args.groups, args.ticks, args.budget,
+                         seed=args.seed, log=log)
+    log(f"corpus: {len(res['corpus'])} program(s), "
+        f"{len(res['coverage'])} coverage signature(s); best score "
+        f"{res['best_score']:.1f}: {describe(res['best'])}")
+
+    rc = 0
+    if res["violations"]:
+        prog, sig = res["violations"][0]
+        log(f"VIOLATION: {sig['unsafe_groups']} unsafe group(s) under "
+            f"{describe(prog)} — shrinking")
+        repro = nsearch.safety_repro(base, args.groups, args.ticks)
+        mini, rep = nsearch.shrink(prog, repro, log=log)
+        cfg_min = dataclasses.replace(base, nemesis=mini)
+        artifact = nsearch.reproducer(
+            cfg_min, args.ticks, rep, engines="xla-safety-fold",
+            n_groups=args.groups,
+            note=f"found by nemesis_search --seed {args.seed} "
+                 f"--budget {args.budget}")
+        nsearch.save_reproducer(args.out, artifact)
+        log(f"minimal reproducer ({len(mini)} clause(s), program "
+            f"{program_hash(mini)}) -> {args.out}: tick {rep['tick']} "
+            f"— {rep['leaf_report']}")
+        rc = 3
+
+    if args.check_kernel:
+        log("cross-engine check: best program through the interpret "
+            "kernel vs the XLA scan")
+        repro = nsearch.divergence_repro(base, _xla_vs_kernel_pair,
+                                         args.groups, args.ticks)
+        rep = repro(res["best"])
+        if rep is None:
+            log("engines bit-identical under the best program")
+        else:
+            mini, rep = nsearch.shrink(res["best"], repro, log=log)
+            cfg_min = dataclasses.replace(base, nemesis=mini)
+            artifact = nsearch.reproducer(
+                cfg_min, args.ticks, rep,
+                engines="xla-vs-pallas-interpret", n_groups=args.groups,
+                note="engine divergence found by --check-kernel")
+            out = args.out.replace(".json", "_divergence.json")
+            nsearch.save_reproducer(out, artifact)
+            log(f"ENGINE DIVERGENCE shrunk -> {out}: tick {rep['tick']} "
+                f"leaf {rep['leaf']}")
+            rc = 3
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,22 +21,16 @@ import pytest
 from raft_tpu import sim
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.cluster import Cluster
+from raft_tpu.obs.triage import oracle_trace
 from raft_tpu.sim.run import TRACE_FIELDS, trace
 
 ALL_FIELDS = TRACE_FIELDS + ("alive",)
 
 
 def cpu_trace(cfg: RaftConfig, n_groups: int, ticks: int):
-    """[T, G, K] numpy trace from the CPU oracle, plus the clusters."""
-    clusters = [Cluster(cfg, group=g) for g in range(n_groups)]
-    out = {f: np.zeros((ticks, n_groups, cfg.k), np.int64) for f in ALL_FIELDS}
-    for t in range(ticks):
-        for g, c in enumerate(clusters):
-            c.tick()
-            for k, view in enumerate(c.snapshot()):
-                for f in ALL_FIELDS:
-                    out[f][t, g, k] = getattr(view, f)
-    return out, clusters
+    """[T, G, K] numpy trace from the CPU oracle, plus the clusters
+    (shared harness: obs.triage.oracle_trace)."""
+    return oracle_trace(cfg, n_groups, ticks)
 
 
 def assert_traces_equal(cpu, jx, context=""):

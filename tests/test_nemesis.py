@@ -1,0 +1,357 @@
+"""Nemesis scenario compiler (DESIGN.md §14, ISSUE r14): gray-failure
+programs compile to the hashed elementwise schedule form and run
+bit-identically on the CPU oracle, the XLA scan, and the Pallas kernel;
+the adversarial search is deterministic; the shrinker minimizes a
+seeded safety violation to a reproducer that replays to the same tick
+and leaf."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from conftest import trees_equal as _trees_equal
+from raft_tpu import nemesis, sim
+from raft_tpu.config import RaftConfig
+from raft_tpu.nemesis import search as nsearch
+from raft_tpu.sim import checkpoint, pkernel
+from raft_tpu.sim.run import metrics_init, run
+from raft_tpu.utils import jrng
+from raft_tpu.utils import rng as pr
+
+BASE = dict(seed=9, k=3, log_cap=8, compact_every=4, drop_prob=0.03,
+            crash_prob=0.1, crash_epoch=24)
+
+
+def _all_kinds_program(ticks: int) -> tuple:
+    """One clause of every kind, overlapping spans — the parity tests'
+    worst case (every seam active, every tag drawn)."""
+    return nemesis.program(
+        nemesis.slow_follower(0, ticks, p=0.7, direction=3),
+        nemesis.flaky_link(0, ticks, p=0.9, burst_epoch=8, burst_p=0.6),
+        nemesis.wan_delay(0, ticks * 2 // 3, sites=2, p=0.4),
+        nemesis.clock_skew(4, ticks - 8, amount=5, node_p=0.6),
+        nemesis.crash_storm(8, ticks * 2 // 3, p=0.3, epoch=4),
+        nemesis.partition_wave(10, ticks - 4, period=16, width=6,
+                               leak_p=0.8))
+
+
+# ------------------------------------------------------ compiled form
+
+
+def test_nem_evaluator_parity_grids():
+    """utils.rng nemesis evaluators == their utils.jrng twins on whole
+    coordinate grids (the test_rng idiom) for a program with every
+    clause kind active."""
+    seed, K, T, G = 9, 3, 24, 5
+    prog = _all_kinds_program(T)
+    cfg = RaftConfig(**{**BASE, "seed": seed}, nemesis=prog)
+    t = np.arange(T, dtype=np.uint32)[:, None, None, None]
+    g = np.arange(G, dtype=np.uint32)[None, :, None, None]
+    a = np.arange(K, dtype=np.uint32)[None, None, :, None]
+    b = np.arange(K, dtype=np.uint32)[None, None, None, :]
+    got_link = np.asarray(jrng.nem_link_ok(seed, cfg.nem_link, g, t,
+                                           a, b, K))
+    got_alive = np.asarray(jrng.nem_alive(seed, cfg.nem_crash, g, a, t))
+    got_extra = np.asarray(jrng.nem_deadline_extra(seed, cfg.nem_skew,
+                                                   g, a, t))
+    for ti in range(T):
+        for gi in range(G):
+            for ai in range(K):
+                assert bool(got_alive[ti, gi, ai, 0]) == pr.nem_alive(
+                    seed, cfg.nem_crash, gi, ai, ti)
+                assert int(got_extra[ti, gi, ai, 0]) \
+                    == pr.nem_deadline_extra(seed, cfg.nem_skew, gi,
+                                             ai, ti)
+                for bi in range(K):
+                    assert bool(got_link[ti, gi, ai, bi]) \
+                        == pr.nem_link_ok(seed, cfg.nem_link, gi, ti,
+                                          ai, bi, K)
+
+
+def test_evaluators_refuse_misfiltered_programs():
+    """A seam evaluator handed a program with no relevant clause raises
+    at build/trace time (never a silent no-op) — the static-gating
+    contract callers rely on."""
+    crash_only = (nemesis.program(nemesis.crash_storm(0, 8)),)
+    for mod in (pr, jrng):
+        with pytest.raises(ValueError, match="no link clause"):
+            mod.nem_link_ok(1, crash_only[0], 0, 0, 0, 1, 3)
+        with pytest.raises(ValueError, match="no timing clause"):
+            mod.nem_deadline_extra(1, crash_only[0], 0, 0, 0)
+        with pytest.raises(ValueError, match="no crash clause"):
+            mod.nem_alive(1, nemesis.program(nemesis.wan_delay(0, 8)),
+                          0, 0, 0)
+    # ...but a link program whose clauses are all STATIC no-ops (a
+    # flaky link in a k=1 group has no links) is legal and passes
+    # everything on BOTH evaluators — no engine asymmetry.
+    noop = nemesis.program(nemesis.flaky_link(0, 8))
+    assert pr.nem_link_ok(1, noop, 0, 0, 0, 0, 1) is True
+    assert bool(jrng.nem_link_ok(1, noop, 0, 0, 0, 0, 1))
+
+
+def test_program_builders_json_hash_and_config_normalization():
+    prog = _all_kinds_program(32)
+    # cids are positional and stable; kinds partition across the seams.
+    assert [c.cid for c in prog] == list(range(6))
+    cfg = RaftConfig(**BASE, nemesis=prog)
+    assert set(cfg.nem_link) | set(cfg.nem_crash) | set(cfg.nem_skew) \
+        == set(prog)
+    assert len(cfg.nem_link) + len(cfg.nem_crash) + len(cfg.nem_skew) \
+        == len(prog)
+    # JSON round trips: the program alone, and the whole config dict.
+    assert nemesis.from_json(nemesis.to_json(prog)) == prog
+    assert nemesis.from_json(json.loads(json.dumps(
+        nemesis.to_json(prog)))) == prog
+    d = json.loads(json.dumps(dataclasses.asdict(cfg)))
+    cfg2 = RaftConfig(**d)
+    assert cfg2 == cfg and hash(cfg2) == hash(cfg)
+    assert nemesis.program_hash(cfg2.nemesis) \
+        == nemesis.program_hash(prog)
+    # Shrink edits change the hash; a re-built survivor set does not.
+    assert nemesis.program_hash(prog[:2]) != nemesis.program_hash(prog)
+    assert nemesis.program(*prog[1:3]) == prog[1:3]   # cids preserved
+
+
+def test_config_rejects_malformed_programs():
+    for bad in ((1, 2, 3),                       # not 8 fields
+                (99, 0, 8, 0, 0, 0, 0, 0),       # unknown kind
+                (pr.NEM_SLOW, 9, 3, 0, 0, 3, 0, 0),   # t1 < t0
+                (pr.NEM_SLOW, 0, 8, 0, 0, 0, 0, 0),   # direction mask 0
+                (pr.NEM_STORM, 0, 8, 0, 0, 0, 0, 0),  # epoch < 1
+                (pr.NEM_WAN, 0, 8, 0, 0, 1, 0, 0),    # < 2 sites
+                (pr.NEM_WAVE, 0, 8, 0, 0, 8, -1, 0),  # b outside u32
+                (pr.NEM_SKEW, 0, 8, 0, 0, 2**31, 0, 0),  # a outside i32
+                (pr.NEM_SLOW, 0, 8, 0, 0, 3, 0, -1)):  # unassigned cid
+        with pytest.raises(ValueError):
+            RaftConfig(**BASE, nemesis=(bad,))
+    with pytest.raises(ValueError, match="unique"):
+        RaftConfig(**BASE, nemesis=(
+            (pr.NEM_SLOW, 0, 8, 0, 0, 3, 0, 0),
+            (pr.NEM_WAN, 0, 8, 0, 0, 2, 0, 0)))
+
+
+# ------------------------------------------- three-engine bit identity
+
+
+def test_oracle_vs_xla_all_kinds_120_ticks():
+    """Acceptance gate, oracle half: a program with EVERY clause kind
+    runs bit-identically on the CPU oracle and the XLA scan, per node
+    per tick, over a >=120-tick faulted universe (shared harness:
+    obs.triage.oracle_divergence)."""
+    from raft_tpu.obs.triage import oracle_divergence
+
+    ticks = 120
+    cfg = RaftConfig(**BASE, nemesis=_all_kinds_program(ticks))
+    assert oracle_divergence(cfg, 8, ticks, oracle_groups=4) is None
+
+
+@pytest.mark.slow
+def test_gray_mix_xla_vs_kernel_120_ticks():
+    """Acceptance gate, kernel half: the canonical gray mix
+    (slow-follower + flaky-link) bit-identical between the XLA scan
+    and the interpret-mode Pallas kernel on the FULL State + Metrics
+    pytrees over a >=120-tick faulted universe, with the per-tick
+    safety fold clean."""
+    ticks, G = 120, 16
+    cfg = RaftConfig(**BASE, nemesis=nemesis.gray_mix(ticks))
+    st0 = sim.init(cfg, n_groups=G)
+    xst, xm = run(cfg, st0, ticks, 0, metrics_init(G))
+    kst, km = pkernel.prun(cfg, st0, ticks, 0, interpret=True)[:2]
+    assert _trees_equal(xst, kst)
+    assert _trees_equal(xm, km)
+    assert int((np.asarray(xm.safety) == 0).sum()) == 0
+
+
+def test_default_off_changes_nothing():
+    """nemesis=() compiles the byte-identical pre-r14 program: same
+    trajectory as a config that never mentions the knob (the cfg-gating
+    contract the contracts pass proves structurally)."""
+    cfg = RaftConfig(**BASE)
+    assert cfg.nemesis == () and not cfg.nem_link and not cfg.nem_crash
+    a, ma = run(cfg, sim.init(cfg, n_groups=8), 32, 0, metrics_init(8))
+    cfg2 = dataclasses.replace(cfg, nemesis=())
+    b, mb = run(cfg2, sim.init(cfg2, n_groups=8), 32, 0, metrics_init(8))
+    assert _trees_equal(a, b) and _trees_equal(ma, mb)
+
+
+# --------------------------------------------------- contracts auditor
+
+
+def test_nemesis_contracts_clean_and_drift_named():
+    from raft_tpu.analysis import contracts
+
+    assert contracts.nemesis_problems() == []
+    # Synthetic drift: a kind routed to no seam, then to two seams.
+    probs = contracts.nemesis_problems(crash_kinds=())
+    assert any("NO engine seam" in p for p in probs)
+    probs = contracts.nemesis_problems(
+        link_kinds=pr.NEM_LINK_KINDS + (pr.NEM_STORM,))
+    assert any("MORE than one seam" in p for p in probs)
+    probs = contracts.nemesis_problems(kinds=pr.NEM_KINDS + (7,))
+    assert any("no program.py builder" in p for p in probs)
+
+
+def test_manifest_r14_keys_both_directions():
+    """The bench nemesis segment's manifest keys are present-but-null
+    from birth and backfilled onto pre-r14 records — the same
+    both-direction proof as PACKING_KEYS at r13."""
+    from raft_tpu.obs import history, manifest
+
+    assert tuple(history.R14_MANIFEST_KEYS) == tuple(manifest.NEMESIS_KEYS)
+    old = {"segment": "x", "ts": 0}
+    new = history.backfill_record(old)
+    for k in manifest.NEMESIS_KEYS:
+        assert k in new and new[k] is None
+    assert "nemesis_program_hash" in manifest.NEMESIS_KEYS
+
+
+# ----------------------------------------------- checkpoint round trip
+
+
+def test_checkpoint_nemesis_roundtrip_and_pre_r14_backfill(tmp_path):
+    """Satellite gate (ISSUE r14): a nemesis-on universe checkpoints
+    and resumes bit-identically; a pre-r14 file (embedded cfg dict
+    missing the knob) backfills to the empty program and loads under a
+    nemesis-free cfg — and REFUSES under a nemesis-on one (a different
+    universe schedule must never silently resume)."""
+    ticks = 40
+    cfg = RaftConfig(**BASE, nemesis=nemesis.gray_mix(80))
+    st, m = run(cfg, sim.init(cfg, n_groups=8), ticks, 0, metrics_init(8))
+    path = tmp_path / "nem.npz"
+    checkpoint.save(path, st, ticks, m, cfg=cfg)
+    st2, t2, m2 = checkpoint.load(path, cfg=cfg)
+    assert t2 == ticks and _trees_equal(st, st2) and _trees_equal(m, m2)
+    a, ma = run(cfg, st, 20, ticks, m)
+    b, mb = run(cfg, st2, 20, t2, m2)
+    assert _trees_equal(a, b) and _trees_equal(ma, mb)
+
+    # Simulate a pre-r14 writer: strip the knob from the embedded cfg.
+    off = RaftConfig(**BASE)
+    st_off = sim.init(off, n_groups=8)
+    old = tmp_path / "pre_r14.npz"
+    checkpoint.save(tmp_path / "off.npz", st_off, 0, cfg=off)
+    with np.load(tmp_path / "off.npz") as z:
+        data = {k: z[k] for k in z.files}
+    saved_cfg = json.loads(bytes(data["__cfg__"]).decode())
+    assert saved_cfg.pop("nemesis") == []
+    data["__cfg__"] = np.bytes_(json.dumps(saved_cfg, sort_keys=True))
+    np.savez(old, **data)
+    st3, t3, _ = checkpoint.load(old, cfg=off)      # backfills to ()
+    assert t3 == 0 and _trees_equal(st_off, st3)
+    with pytest.raises(ValueError, match="cfg mismatch"):
+        checkpoint.load(old, cfg=cfg)
+
+
+# ------------------------------------------------- search and shrinker
+
+
+def test_search_is_deterministic():
+    """Two hunts from the same seed produce identical corpora,
+    coverage maps, and scores (every draw is a hash_u32 of
+    (seed, step) — the repo's determinism rule applied to the search)."""
+    base = RaftConfig(**BASE)
+    a = nsearch.search(base, 8, 16, budget=2, seed=3)
+    b = nsearch.search(base, 8, 16, budget=2, seed=3)
+    assert a["corpus"] == b["corpus"]
+    assert a["coverage"] == b["coverage"]
+    assert a["best"] == b["best"] and a["best_score"] == b["best_score"]
+    assert a["violations"] == b["violations"]
+    # Mutation itself is pure in (prog, seed, step).
+    prog = nemesis.gray_mix(16)
+    for step in range(8):
+        assert nsearch.mutate(prog, 16, 5, step) \
+            == nsearch.mutate(prog, 16, 5, step)
+
+
+def test_shrinker_seeded_violation_deterministic(tmp_path):
+    """Satellite gate (ISSUE r14): a synthetic safety violation — a
+    term corrupted mid-run, armed only while the program is active —
+    shrinks to a <=2-clause program whose triage names the exact tick
+    and leaf, deterministically across two runs; the serialized
+    reproducer round-trips and replays to the same (tick, leaf)."""
+    ticks, corrupt_t = 24, 9
+    base = RaftConfig(**BASE)
+    prog = nemesis.program(
+        nemesis.slow_follower(0, ticks, p=0.7),
+        nemesis.flaky_link(0, ticks, p=0.9, burst_epoch=8, burst_p=0.6))
+    pair = nsearch.term_corruption_pair(corrupt_t, group=0, node=1)
+    # chunk=1: one compiled program per candidate config (the shrink
+    # loop's wall time is XLA compiles, not tick execution).
+    repro = nsearch.divergence_repro(base, pair, 4, ticks, chunk=1)
+
+    runs = []
+    for _ in range(2):
+        mini, rep = nsearch.shrink(prog, repro)
+        runs.append((mini, rep["tick"], rep["leaf"]))
+    assert runs[0] == runs[1], "shrink is not deterministic"
+    mini, tick, leaf = runs[0]
+    assert len(mini) <= 2
+    assert tick == corrupt_t
+    assert "term" in leaf
+    # The surviving clause kept its original cid (schedule-preserving
+    # minimization) and still covers the corruption tick.
+    assert all(c[7] in {0, 1} for c in mini)
+    assert all(c[1] <= corrupt_t < c[2] for c in mini)
+
+    # Artifact: save -> load -> verify replays the same tick + leaf.
+    cfg_min = dataclasses.replace(base, nemesis=mini)
+    art = nsearch.reproducer(
+        cfg_min, ticks, rep, engines="xla-vs-seeded-corruption",
+        inject={"kind": "term_flip", "tick": corrupt_t,
+                "group": 0, "node": 1, "bump": 4},
+        n_groups=4, note="test_shrinker_seeded_violation_deterministic")
+    p = tmp_path / "repro.json"
+    nsearch.save_reproducer(str(p), art)
+    cfg_loaded, art_loaded = nsearch.load_reproducer(str(p))
+    assert cfg_loaded.nemesis == mini
+    fresh = nsearch.verify_reproducer(art_loaded, repro)
+    assert fresh["tick"] == corrupt_t
+
+    # Tampered artifacts are refused, naming the drift.
+    bad = dict(art, program_hash="00000000")
+    nsearch.save_reproducer(str(tmp_path / "bad.json"), bad)
+    with pytest.raises(ValueError, match="program_hash"):
+        nsearch.load_reproducer(str(tmp_path / "bad.json"))
+
+
+@pytest.mark.slow
+def test_checked_in_example_reproducer_replays():
+    """The checked-in artifact (NEMESIS_repro_example.json, written by
+    `nemesis_search.py --seed-violation`) still replays to its recorded
+    tick + leaf via bisect_divergence — a reproducer that stops
+    reproducing is itself a finding."""
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "NEMESIS_repro_example.json")
+    cfg, art = nsearch.load_reproducer(path)
+    inject = art["inject"]
+    pair = nsearch.term_corruption_pair(inject["tick"], inject["group"],
+                                        inject["node"], inject["bump"])
+    repro = nsearch.divergence_repro(cfg, pair, art["n_groups"],
+                                     art["n_ticks"])
+    rep = nsearch.verify_reproducer(art, repro)
+    assert rep["tick"] == art["violation"]["tick"]
+
+
+def test_run_signals_and_scoring_shapes():
+    """The searcher's health signals come back as host ints with the
+    documented keys, and the coverage key is insensitive to sub-bucket
+    jitter but sensitive to a violation."""
+    cfg = RaftConfig(**BASE, nemesis=nemesis.gray_mix(16))
+    sig = nsearch.run_signals(cfg, 8, 16)
+    assert set(sig) == {"unsafe_groups", "elections", "max_leaderless",
+                        "committed", "stalled_groups",
+                        "dual_leader_groups", "term_spread",
+                        "storm_ticks"}
+    assert all(isinstance(v, int) for v in sig.values())
+    assert sig["unsafe_groups"] == 0
+    assert nsearch.near_miss_score(sig) >= 0.0
+    bumped = dict(sig, unsafe_groups=1)
+    assert nsearch.near_miss_score(bumped) \
+        > nsearch.near_miss_score(sig) + 999
+    assert nsearch.coverage_key(bumped) != nsearch.coverage_key(sig)
