@@ -216,6 +216,18 @@ def _nemesis_fields(cfg) -> dict:
     return vals
 
 
+def _stream_fields(cfg, measured=None) -> dict:
+    """The r16 manifest stamp: the residency knobs plus the predicted /
+    measured overlap efficiency of the cohort paging pipeline
+    (obs.manifest.STREAM_KEYS, null-by-default in every record until
+    stamped here; DESIGN.md §15). `measured` is the compute_s / wall_s
+    split from a streamed run's pipeline stats — None on resident
+    engines and off-TPU (predicted still derives whenever the segment's
+    cfg streams, so the model stays inspectable on CPU boxes)."""
+    return obs_roofline.stream_segment_fields(cfg, measured=measured,
+                                              chunk_ticks=CHUNK)
+
+
 def _roofline_fields(cfg, n_groups: int, engine: str, ticks: int,
                      timed_wall_s, nd: int = 1) -> dict:
     """The roofline stamp every segment carries (DESIGN.md §12):
@@ -368,9 +380,13 @@ def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
       the kernel's 2*CHUNK + timed_ticks endpoint, then the two
       universes must be bit-identical.
     """
+    if cfg.stream_groups:   # r16: the cohort scheduler carries the kernel
+        return _streamed_segment(cfg, n_groups, timed_ticks, counter_name,
+                                 st_ref, m_ref, f_ref, what)
     fail = dict(rate=None, count=None, elapsed=None, warmup_s=None,
                 state_identical=None, metrics_identical=None,
-                flight_identical=None, engine="pallas-fused-chunk", nd=1)
+                flight_identical=None, engine="pallas-fused-chunk", nd=1,
+                overlap_measured=None)
     try:   # kernel failure of ANY kind (incl. import) never kills the bench
         from raft_tpu.sim import pkernel
         # Sharded engine when >1 chip is visible (DESIGN.md §9): same
@@ -426,7 +442,7 @@ def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
                 "+ full Metrics + flight ring bit-identical")
             return dict(rate=rate, count=count, elapsed=elapsed,
                         warmup_s=warmup_s, status="ok", engine=name,
-                        nd=nd, **verdicts)
+                        nd=nd, overlap_measured=None, **verdicts)
         log(f"  [pallas] DIFFERENTIAL MISMATCH (state_identical={state_ok} "
             f"metrics_identical={metrics_ok} flight_identical={flight_ok})"
             f" - kernel number discarded")
@@ -444,6 +460,88 @@ def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
         return {**fail, "status": f"error: {type(e).__name__}"}
 
 
+def _streamed_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
+                      st_ref, m_ref, f_ref, what: str):
+    """--stream twin of `_pallas_segment` (DESIGN.md §15): the cohort
+    scheduler pages the fleet host<->HBM under the unchanged kernel.
+    Same warmup/timing/promotion protocol — warmup advances the SAME
+    universe by 2*CHUNK ticks (absorbing the window-shape compile), the
+    timed region is one `stream_ticks` pass over the remaining ticks,
+    and promotion requires the full State + full Metrics + flight ring
+    bit-identical to the XLA reference at the same tick. Adds
+    `overlap_measured` (compute_s / wall_s from the pipeline stats) for
+    the STREAM_KEYS stamp; single-device by construction (the sharded
+    mesh path stays resident — host paging composes per chip, owed to
+    the driver's TPU pod column)."""
+    from raft_tpu.parallel import cohort
+    fail = dict(rate=None, count=None, elapsed=None, warmup_s=None,
+                state_identical=None, metrics_identical=None,
+                flight_identical=None, engine=cohort.ENGINE, nd=1,
+                overlap_measured=None)
+    try:   # kernel failure of ANY kind never kills the bench
+        from raft_tpu.sim import pkernel
+        if not (pkernel.supported(cfg, n_groups, 1)
+                and jax.devices()[0].platform == "tpu"):
+            return {**fail, "status": "unsupported"}
+        counter_fn = functools.partial(getattr(pkernel, counter_name), cfg)
+        host, g = cohort.host_wire(cfg, sim.init(cfg, n_groups=n_groups),
+                                   flight=flight_init(n_groups))
+        t0 = time.perf_counter()
+        with obs_trace.span(f"warmup+compile streamed [{what}]"):
+            cohort.stream_ticks(cfg, host, g, 0, 2 * CHUNK,
+                                chunk_ticks=CHUNK)
+            base = counter_fn(host, g)
+        warmup_s = time.perf_counter() - t0
+        log(f"  [streamed] warmup {2 * CHUNK} ticks (incl. compile): "
+            f"{warmup_s:.1f}s")
+        stats: dict = {}
+        start = time.perf_counter()
+        with obs_trace.span(f"timed streamed [{what}]"):
+            cohort.stream_ticks(cfg, host, g, 2 * CHUNK, timed_ticks,
+                                chunk_ticks=CHUNK, stats=stats)
+            count = counter_fn(host, g) - base   # fetch closes the timer
+        elapsed = time.perf_counter() - start
+        rate = count / elapsed
+        log(f"  [streamed] {n_groups} groups x {timed_ticks} ticks "
+            f"({stats['cohorts']} cohort windows, {stats['launches']} "
+            f"launches): {count} {what} in {elapsed:.2f}s -> "
+            f"{rate:,.0f} {what}/s (measured overlap "
+            f"{stats['overlap_efficiency_measured']:.2f})")
+        st_ref, m_ref, f_ref = run_recorded(cfg, st_ref, CHUNK,
+                                            CHUNK + timed_ticks, m_ref,
+                                            f_ref)
+        leaves = tuple(host)
+        st_s, m_s = pkernel.kfinish(cfg, leaves, g)
+        f_s = pkernel.kflight(cfg, leaves, g)
+        state_ok, s_why = _trees_equal_why(st_ref, st_s)
+        metrics_ok, m_why = _trees_equal_why(m_ref, m_s)
+        flight_ok, f_why = _trees_equal_why(f_ref, f_s)
+        verdicts = dict(state_identical=state_ok,
+                        metrics_identical=metrics_ok,
+                        flight_identical=flight_ok)
+        if state_ok and metrics_ok and flight_ok:
+            log("  [streamed] differential vs xla at same tick: full State "
+                "+ full Metrics + flight ring bit-identical")
+            return dict(rate=rate, count=count, elapsed=elapsed,
+                        warmup_s=warmup_s, status="ok",
+                        engine=cohort.ENGINE, nd=1,
+                        overlap_measured=stats.get(
+                            "overlap_efficiency_measured"), **verdicts)
+        log(f"  [streamed] DIFFERENTIAL MISMATCH (state_identical="
+            f"{state_ok} metrics_identical={metrics_ok} flight_identical="
+            f"{flight_ok}) - streamed number discarded")
+        for why in (s_why, m_why, f_why):
+            if why:
+                log(f"  [streamed] {why}")
+        dump_flight(f_ref, label="xla-ref")
+        dump_flight(f_s, label="streamed")
+        return {**fail, **verdicts, "warmup_s": warmup_s,
+                "status": "mismatch"}
+    except Exception as e:   # kernel failure must never kill the bench
+        log(f"  [streamed] failed ({type(e).__name__}: {e}); xla stands")
+        return {**fail, "status": f"error: {type(e).__name__}"}
+
+
 def _pallas_full_run(cfg, n_groups: int, ticks: int, counter_name: str,
                      label: str, st_ref, m_ref, f_ref):
     """Kernel-side FROM-TICK-0 driver shared by the histogram-bearing
@@ -458,9 +556,13 @@ def _pallas_full_run(cfg, n_groups: int, ticks: int, counter_name: str,
     k_warmup_s, state_ok, metrics_ok, flight_ok, nd, k_name}; `engine`
     is the PROMOTED string ("xla-scan" or an annotated fallback).
     Kernel failure of ANY kind never raises out."""
+    if cfg.stream_groups:   # r16: the cohort scheduler carries the kernel
+        return _streamed_full_run(cfg, n_groups, ticks, counter_name,
+                                  label, st_ref, m_ref, f_ref)
     out = dict(engine="xla-scan", promoted=False, k_elapsed=None,
                k_warmup_s=None, state_ok=None, metrics_ok=None,
-               flight_ok=None, nd=1, k_name="pallas-fused-chunk")
+               flight_ok=None, nd=1, k_name="pallas-fused-chunk",
+               overlap_measured=None)
     try:
         from raft_tpu.sim import pkernel
         nd, k_name, kinit, kstep = _kernel_engine(cfg, n_groups)
@@ -524,6 +626,80 @@ def _pallas_full_run(cfg, n_groups: int, ticks: int, counter_name: str,
     return out
 
 
+def _streamed_full_run(cfg, n_groups: int, ticks: int, counter_name: str,
+                       label: str, st_ref, m_ref, f_ref):
+    """--stream twin of `_pallas_full_run` (DESIGN.md §15): the
+    from-tick-0 histogram segments under the cohort scheduler. Same
+    protocol — throwaway-universe warmup absorbs the window-shape
+    compile, the timed region streams the real universe from tick 0,
+    promotion requires the full State + full Metrics + flight ring
+    bit-identical against the XLA reference. Fills `overlap_measured`
+    from the pipeline stats for the STREAM_KEYS stamp."""
+    from raft_tpu.parallel import cohort
+    out = dict(engine="xla-scan", promoted=False, k_elapsed=None,
+               k_warmup_s=None, state_ok=None, metrics_ok=None,
+               flight_ok=None, nd=1, k_name=cohort.ENGINE,
+               overlap_measured=None)
+    try:
+        from raft_tpu.sim import pkernel
+        if not (pkernel.supported(cfg, n_groups, 1)
+                and jax.devices()[0].platform == "tpu"):
+            return out
+        counter = functools.partial(getattr(pkernel, counter_name), cfg)
+        t0 = time.perf_counter()
+        with obs_trace.span(f"warmup+compile streamed [{label}]"):
+            wh, wg = cohort.host_wire(cfg,
+                                      sim.init(cfg, n_groups=n_groups),
+                                      flight=flight_init(n_groups))
+            cohort.stream_ticks(cfg, wh, wg, 0, CHUNK, chunk_ticks=CHUNK)
+            counter(wh, wg)
+        out["k_warmup_s"] = time.perf_counter() - t0
+        log(f"  [streamed] warmup (incl. compile): "
+            f"{out['k_warmup_s']:.1f}s")
+        host, g = cohort.host_wire(cfg, sim.init(cfg, n_groups=n_groups),
+                                   flight=flight_init(n_groups))
+        stats: dict = {}
+        start = time.perf_counter()
+        with obs_trace.span(f"timed streamed [{label}]"):
+            cohort.stream_ticks(cfg, host, g, 0, ticks, chunk_ticks=CHUNK,
+                                stats=stats)
+            counter(host, g)   # fetch closes the timer
+        out["k_elapsed"] = time.perf_counter() - start
+        out["overlap_measured"] = stats.get("overlap_efficiency_measured")
+        leaves = tuple(host)
+        st_s, m_s = pkernel.kfinish(cfg, leaves, g)
+        f_s = pkernel.kflight(cfg, leaves, g)
+        state_ok, s_why = _trees_equal_why(st_ref, st_s)
+        metrics_ok, m_why = _trees_equal_why(m_ref, m_s)
+        flight_ok, f_why = _trees_equal_why(f_ref, f_s)
+        out.update(state_ok=state_ok, metrics_ok=metrics_ok,
+                   flight_ok=flight_ok)
+        log(f"  [streamed] {label} {n_groups} groups x {ticks} ticks "
+            f"({stats['cohorts']} cohort windows) in "
+            f"{out['k_elapsed']:.2f}s "
+            f"({out['k_elapsed'] / ticks * 1e3:.2f} ms/tick, measured "
+            f"overlap {stats['overlap_efficiency_measured']:.2f})")
+        if state_ok and metrics_ok and flight_ok:
+            log("  [streamed] differential vs xla at same tick: full "
+                "State + full Metrics + flight ring bit-identical")
+            out.update(engine=cohort.ENGINE, promoted=True)
+        else:
+            log(f"  [streamed] DIFFERENTIAL MISMATCH (state_identical="
+                f"{state_ok} metrics_identical={metrics_ok} "
+                f"flight_identical={flight_ok}) - streamed number "
+                f"discarded")
+            for why in (s_why, m_why, f_why):
+                if why:
+                    log(f"  [streamed] {why}")
+            dump_flight(f_ref, label=f"{label}:xla-ref")
+            dump_flight(f_s, label=f"{label}:streamed")
+            out["engine"] = "xla-scan (streamed mismatch!)"
+    except Exception as e:   # kernel failure must never kill the bench
+        log(f"  [streamed] failed ({type(e).__name__}: {e}); xla stands")
+        out["engine"] = f"xla-scan (streamed error: {type(e).__name__})"
+    return out
+
+
 def bench_throughput(n_groups: int, ticks: int):
     """Config 2/3/5 shape: steady-state replication throughput.
 
@@ -570,6 +746,7 @@ def bench_throughput(n_groups: int, ticks: int):
         **_roofline_fields(cfg, n_groups, engine, timed_ticks, elapsed,
                            nd=pal["nd"] if engine == pal["engine"] else 1),
         **_packing_fields(cfg),
+        **_stream_fields(cfg, pal.get("overlap_measured")),
     }
     emit_manifest("throughput", cfg, device=_device_str(),
                   n_groups=n_groups, **seg)
@@ -662,6 +839,7 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
         **_roofline_fields(cfg, n_groups, engine, ticks, elapsed,
                            nd=nd if engine == k_name else 1),
         **_packing_fields(cfg),
+        **_stream_fields(cfg, pal.get("overlap_measured")),
     }
     emit_manifest(label, cfg, device=_device_str(),
                   **{k: v for k, v in seg.items() if k != "p99_note"})
@@ -751,6 +929,7 @@ def bench_nemesis(seed: int, n_groups: int, ticks: int, label: str):
         **_roofline_fields(cfg, n_groups, engine, ticks, elapsed,
                            nd=nd if engine == k_name else 1),
         **_packing_fields(cfg),
+        **_stream_fields(cfg, pal.get("overlap_measured")),
     }
     emit_manifest(label, cfg, device=_device_str(), **seg)
     return seg
@@ -804,6 +983,7 @@ def bench_election_rounds(n_groups: int, ticks: int):
         **_roofline_fields(cfg, n_groups, engine, timed_ticks, elapsed,
                            nd=pal["nd"] if engine == pal["engine"] else 1),
         **_packing_fields(cfg),
+        **_stream_fields(cfg, pal.get("overlap_measured")),
     }
     emit_manifest("election-rounds", cfg, device=_device_str(),
                   n_groups=n_groups, ticks=timed_ticks, **seg)
@@ -849,6 +1029,7 @@ def bench_reads(n_groups: int, ticks: int):
         **_roofline_fields(cfg, n_groups, engine, timed_ticks, elapsed,
                            nd=pal["nd"] if engine == pal["engine"] else 1),
         **_packing_fields(cfg),
+        **_stream_fields(cfg, pal.get("overlap_measured")),
     }
     emit_manifest("reads", cfg, device=_device_str(), n_groups=n_groups,
                   ticks=timed_ticks, **seg)
@@ -952,6 +1133,7 @@ def bench_clients(seed: int, n_groups: int, ticks: int, label: str):
         **_roofline_fields(cfg, n_groups, engine, ticks, elapsed,
                            nd=nd if engine == k_name else 1),
         **_packing_fields(cfg),
+        **_stream_fields(cfg, pal.get("overlap_measured")),
     }
     emit_manifest(label, cfg, device=_device_str(), **seg)
     return seg
@@ -982,6 +1164,18 @@ def main():
                          "— the packed kernel must still match the XLA "
                          "reference bit-for-bit — so this is the "
                          "measured-delta run for the layout ablation")
+    ap.add_argument("--stream", action="store_true",
+                    help="run every kernel segment through the r16 "
+                         "cohort scheduler (stream_groups; DESIGN.md "
+                         "§15): the fleet's wire lives in host RAM and "
+                         "is paged block-cohorts at a time through HBM "
+                         "under the unchanged kernel. Promotion gates "
+                         "are unchanged; every segment additionally "
+                         "stamps predicted + measured overlap "
+                         "efficiency (obs.manifest.STREAM_KEYS)")
+    ap.add_argument("--cohort-blocks", type=int, default=None,
+                    help="with --stream: 1024-group blocks per cohort "
+                         "window (default: config default, 4)")
     args = ap.parse_args()
 
     if args.pack_wire:
@@ -994,6 +1188,17 @@ def main():
                            alias_wire=True)
         log("packed wire: pack_bools + pack_ring + alias_wire on for "
             "every segment (wire_hist stays on for the histograms)")
+
+    if args.stream:
+        _WIRE_DIALS.update(stream_groups=True)
+        if args.cohort_blocks is not None:
+            _WIRE_DIALS.update(cohort_blocks=args.cohort_blocks)
+        log(f"cohort streaming: stream_groups on for every kernel "
+            f"segment (cohort_blocks="
+            f"{args.cohort_blocks if args.cohort_blocks is not None else 4}"
+            f"; the XLA reference engine stays resident)")
+    elif args.cohort_blocks is not None:
+        ap.error("--cohort-blocks requires --stream")
 
     tracer = None
     if args.trace_dir:

@@ -298,15 +298,49 @@ def derived_wire_model(cfg: RaftConfig, with_flight: bool = True) -> dict:
 
     # HBM-boundary consistency: the published ceiling must be the exact
     # supported() boundary (whole blocks; one more block must tip it).
-    ceiling = pkernel.hbm_ceiling_groups(cfg, with_flight=with_flight)
-    hbm_ok = (pkernel.supported(cfg, n_groups=ceiling,
+    rcfg = dataclasses.replace(cfg, stream_groups=False)
+    ceiling = pkernel.hbm_ceiling_groups(rcfg, with_flight=with_flight)
+    hbm_ok = (pkernel.supported(rcfg, n_groups=ceiling,
                                 with_flight=with_flight)
-              and not pkernel.supported(cfg, n_groups=ceiling + pkernel.GB,
+              and not pkernel.supported(rcfg, n_groups=ceiling + pkernel.GB,
                                         with_flight=with_flight))
     if not hbm_ok:
         problems.append(
             f"hbm_ceiling_groups {ceiling} is not the exact supported() "
             f"boundary (with_flight={with_flight})")
+
+    # Streamed residency (r16, DESIGN.md §15): under cfg.stream_groups
+    # the fleet's ONE wire copy lives in host RAM and only the cohort
+    # window is HBM-resident — reconcile the published streamed ceiling
+    # against THIS module's independently derived wire bytes (not
+    # pkernel's own model) and pin the exact supported() boundary of
+    # the streamed branch, the same no-over-promise rule as the static
+    # ceiling above.
+    scfg = dataclasses.replace(cfg, stream_groups=True)
+    streamed_ceiling = pkernel.streamed_ceiling_groups(
+        scfg, with_flight=with_flight)
+    window_hbm = pkernel.cohort_hbm_bytes(scfg, with_flight=with_flight)
+    streamed_ok = (
+        window_hbm <= pkernel.HBM_LIMIT_BYTES
+        and pkernel.supported(scfg, n_groups=streamed_ceiling,
+                              with_flight=with_flight)
+        and not pkernel.supported(scfg,
+                                  n_groups=streamed_ceiling + pkernel.GB,
+                                  with_flight=with_flight))
+    if not streamed_ok:
+        problems.append(
+            f"streamed_ceiling_groups {streamed_ceiling} is not the exact "
+            f"supported() boundary under stream_groups "
+            f"(with_flight={with_flight})")
+    expect_streamed = (pkernel.HOST_RAM_LIMIT_BYTES
+                       // (4 * derived_words * pkernel.GB)) * pkernel.GB
+    if streamed_ceiling != expect_streamed:
+        problems.append(
+            f"streamed ceiling {streamed_ceiling} != "
+            f"{expect_streamed} implied by the derived wire bytes "
+            f"(4 x {derived_words} words/group, whole blocks, "
+            f"{pkernel.HOST_RAM_LIMIT_BYTES} B host RAM) — the streamed "
+            f"residency model drifted from the derived byte model")
 
     return {
         "config": {"k": cfg.k, "log_cap": cfg.log_cap,
@@ -335,7 +369,19 @@ def derived_wire_model(cfg: RaftConfig, with_flight: bool = True) -> dict:
                 "limit_bytes": pkernel.HBM_LIMIT_BYTES,
                 # 2 = in+out buffers live across a launch; 1 under the
                 # alias_wire dial (input/output aliasing + donation).
-                "residency_buffers": pkernel._residency_buffers(cfg)},
+                "residency_buffers": pkernel._residency_buffers(cfg),
+                # r16 cohort streaming: with the fleet paged from host
+                # RAM the ceiling is host-bound — only the
+                # stream-window blocks (prev awaiting d2h + current x
+                # residency + next prefetched) are HBM-resident.
+                "streamed": {
+                    "ceiling_groups": streamed_ceiling,
+                    "boundary_exact": bool(streamed_ok),
+                    "host_limit_bytes": pkernel.HOST_RAM_LIMIT_BYTES,
+                    "cohort_blocks": scfg.cohort_blocks,
+                    "stream_windows": pkernel._stream_windows(scfg),
+                    "window_hbm_bytes": window_hbm,
+                }},
         "problems": problems,
     }
 

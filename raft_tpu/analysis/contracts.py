@@ -46,6 +46,13 @@ GATED_LEAVES = {
     # contract (like read_every), enforced by the gating pass AND by
     # nemesis_problems below.
     "nemesis": ((), (), ()),
+    # Cohort streaming (DESIGN.md §15) gates NOTHING either: the
+    # residency knobs (config.STREAM_FIELDS) only move where the wire
+    # LIVES between chunk launches — zero new State leaves, zero new
+    # wire lanes. The empty row is the contract (like read_every and
+    # nemesis), enforced by the gating pass AND by streaming_problems
+    # below.
+    "streaming": ((), (), ()),
 }
 
 
@@ -77,6 +84,8 @@ def _gate_cfgs() -> dict:
                                        client_slots=2),
         "nemesis": dataclasses.replace(base,
                                        nemesis=_nemesis_probe_program()),
+        "streaming": dataclasses.replace(base, stream_groups=True,
+                                         cohort_blocks=1),
     }
 
 
@@ -235,7 +244,8 @@ def wire_registry_problems(pernode_fields: tuple | None = None,
             on = {"prevote": cfg.prevote,
                   "transfer": cfg.transfer_u32 != 0,
                   "clients": clients,
-                  "nemesis": bool(cfg.nemesis)}[gate]
+                  "nemesis": bool(cfg.nemesis),
+                  "streaming": cfg.stream_groups}[gate]
             if not on:
                 gated_mb.update(mb)
         want_mb = [f for f in mailbox_fields if f not in gated_mb]
@@ -857,6 +867,150 @@ def nemesis_problems(kinds: tuple | None = None,
     return problems
 
 
+# --------------------------------------------------- cohort streaming
+
+
+def _streamed_cfgs() -> dict:
+    """label -> a config exercising each r16 residency-knob combination
+    the streaming pass audits (built on the small `_base_cfg` universe
+    so every derived check stays eval_shape-cheap)."""
+    base = _base_cfg()
+    return {
+        "streamed": dataclasses.replace(base, stream_groups=True),
+        "streamed-1blk": dataclasses.replace(base, stream_groups=True,
+                                             cohort_blocks=1),
+        "streamed-dials": dataclasses.replace(
+            base, stream_groups=True, cohort_blocks=2, pack_bools=True,
+            pack_ring=True, alias_wire=True, wire_hist=False),
+        "streamed-clients": dataclasses.replace(
+            _gate_cfgs()["clients"], stream_groups=True, cohort_blocks=2),
+    }
+
+
+def streaming_problems(include_behavioral: bool = True) -> list[str]:
+    """The r16 cohort-paging contracts (DESIGN.md §15):
+
+    - the residency knobs (config.STREAM_FIELDS) are RESIDENCY-ONLY —
+      flipping them changes zero State pytree leaves, zero kernel wire
+      registries, zero wire words (GATED_LEAVES carries the empty
+      'streaming' row, like read_every and nemesis), and the real kinit
+      output under eval_shape is shape/dtype-identical;
+    - the streamed residency model is self-consistent: the cohort
+      window fits HBM at every audited layout, and
+      `pkernel.streamed_ceiling_groups` is the EXACT `supported()`
+      boundary (whole blocks; one more block must tip it — the same
+      no-over-promise rule as hbm_ceiling_groups);
+    - (behavioral) the cohort scheduler's window slicing + writeback is
+      the identity on the host wire (paging moves bytes, never edits
+      them), and a checkpoint written under one residency loads under
+      the other (config.STREAM_FIELDS are excluded from the semantic
+      match, so a streamed run can resume every pre-r16 file).
+    """
+    import jax
+
+    from raft_tpu import sim
+    from raft_tpu.config import STREAM_FIELDS
+    from raft_tpu.sim import pkernel
+
+    problems = []
+    defaults = RaftConfig()
+    for f in STREAM_FIELDS:
+        if not hasattr(defaults, f):
+            problems.append(f"config.STREAM_FIELDS names {f!r} but "
+                            f"RaftConfig has no such field")
+            return problems
+    if defaults.stream_groups:
+        problems.append("cfg.stream_groups defaults ON — the default "
+                        "wire/programs/checkpoints must stay byte-"
+                        "identical to r14 (stream knobs are opt-in)")
+    if GATED_LEAVES.get("streaming") != ((), (), ()):
+        problems.append("GATED_LEAVES 'streaming' row is not empty — the "
+                        "residency knobs must gate no leaves")
+    for label, cfg in _streamed_cfgs().items():
+        off = dataclasses.replace(cfg, stream_groups=False,
+                                  cohort_blocks=defaults.cohort_blocks)
+        if _leaf_names(cfg) != _leaf_names(off):
+            problems.append(
+                f"[{label}] residency knobs changed State pytree leaves — "
+                f"they must be invisible to the XLA/oracle engines")
+        for fn in (pkernel._mb_fields, pkernel._n_state_leaves,
+                   pkernel._active_metric_leaves,
+                   pkernel.wire_words_per_group):
+            if fn(cfg) != fn(off):
+                problems.append(
+                    f"[{label}] residency knobs changed pkernel."
+                    f"{fn.__name__} — streaming must add no wire lanes "
+                    f"(kleaf_spec would not cover them)")
+        st_on = jax.eval_shape(lambda c=cfg: sim.init(c, n_groups=2))
+        st_off = jax.eval_shape(lambda c=off: sim.init(c, n_groups=2))
+        lv_on = jax.eval_shape(
+            lambda s, c=cfg: pkernel.kinit(c, s)[0], st_on)
+        lv_off = jax.eval_shape(
+            lambda s, c=off: pkernel.kinit(c, s)[0], st_off)
+        if [(tuple(a.shape), str(a.dtype)) for a in lv_on] \
+                != [(tuple(a.shape), str(a.dtype)) for a in lv_off]:
+            problems.append(f"[{label}] residency knobs changed the kinit "
+                            f"wire leaves (shape/dtype drift)")
+        # Residency model: window fits HBM, ceiling is the exact
+        # supported() boundary under the streamed branch.
+        if pkernel.cohort_hbm_bytes(cfg) > pkernel.HBM_LIMIT_BYTES:
+            problems.append(
+                f"[{label}] cohort window ({cfg.cohort_blocks} blocks, "
+                f"{pkernel.cohort_hbm_bytes(cfg)} B) does not fit the "
+                f"{pkernel.HBM_LIMIT_BYTES} B HBM budget")
+            continue
+        ceiling = pkernel.streamed_ceiling_groups(cfg)
+        if not (pkernel.supported(cfg, n_groups=ceiling)
+                and not pkernel.supported(cfg,
+                                          n_groups=ceiling + pkernel.GB)):
+            problems.append(
+                f"[{label}] streamed_ceiling_groups {ceiling} is not the "
+                f"exact supported() boundary under stream_groups")
+
+    if not include_behavioral:
+        return problems
+    import numpy as np
+
+    from raft_tpu.parallel import cohort
+
+    # Paging is the identity: page a real host wire through every
+    # window (h2d + d2h, zero ticks of kernel in between) and the bytes
+    # must come back exact — a lossy slice/reassembly would corrupt
+    # state silently under real runs.
+    cfg = _streamed_cfgs()["streamed-1blk"]
+    host_leaves, g = cohort.host_wire(cfg, sim.init(cfg, n_groups=2))
+    before = [a.copy() for a in host_leaves]
+    for s0, s1 in cohort.cohort_windows(cfg, host_leaves):
+        cohort._writeback(host_leaves, cohort._window(host_leaves, s0, s1),
+                          s0, s1)
+    for i, (a, b) in enumerate(zip(before, host_leaves)):
+        if not np.array_equal(a, b):
+            problems.append(
+                f"cohort paging round trip changed wire leaf #{i} — "
+                f"window slicing/writeback must be the identity")
+    # A checkpoint saved under one residency loads under the other (and
+    # a pre-r16 file — no stream keys at all — loads under a streamed
+    # cfg: the same backfill rule, exercised via the defaults table).
+    from raft_tpu.sim import checkpoint as ckpt
+    cfg_off = _base_cfg()
+    cfg_on = _streamed_cfgs()["streamed"]
+    for src, dst, what in ((cfg_off, cfg_on, "resident->streamed"),
+                           (cfg_on, cfg_off, "streamed->resident")):
+        stx = sim.init(src, n_groups=2)
+        buf = io.BytesIO()
+        ckpt.save(buf, stx, 3, cfg=src)
+        buf.seek(0)
+        try:
+            ckpt.load(buf, cfg=dst)
+        except Exception as e:  # noqa: BLE001 — audited, not handled
+            problems.append(
+                f"cross-residency checkpoint load ({what}) raised "
+                f"{type(e).__name__}: {e} — config.STREAM_FIELDS must be "
+                f"excluded from the semantic match (a streamed run could "
+                f"never resume a pre-r16 file)")
+    return problems
+
+
 # ------------------------------------------------------- manifest schema
 
 
@@ -875,7 +1029,14 @@ def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
     hist = real_history if history_mod is None else history_mod
     problems = []
     keys = (real_manifest.ROOFLINE_KEYS + real_manifest.PACKING_KEYS
-            + real_manifest.NEMESIS_KEYS)
+            + real_manifest.NEMESIS_KEYS + real_manifest.STREAM_KEYS)
+    if tuple(real_history.R16_MANIFEST_KEYS) \
+            != tuple(real_manifest.STREAM_KEYS):
+        problems.append(
+            f"obs.history.R16_MANIFEST_KEYS {real_history.R16_MANIFEST_KEYS}"
+            f" != obs.manifest.STREAM_KEYS "
+            f"{real_manifest.STREAM_KEYS} — the emit-side and "
+            f"backfill-side key lists drifted")
     if tuple(real_history.R14_MANIFEST_KEYS) \
             != tuple(real_manifest.NEMESIS_KEYS):
         problems.append(
@@ -897,12 +1058,18 @@ def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
             f" != obs.manifest.PACKING_KEYS "
             f"{real_manifest.PACKING_KEYS} — the emit-side and "
             f"backfill-side key lists drifted")
-    from raft_tpu.config import LAYOUT_FIELDS
+    from raft_tpu.config import LAYOUT_FIELDS, STREAM_FIELDS
     if tuple(real_manifest.PACKING_KEYS) != tuple(LAYOUT_FIELDS):
         problems.append(
             f"obs.manifest.PACKING_KEYS {real_manifest.PACKING_KEYS} != "
             f"config.LAYOUT_FIELDS {LAYOUT_FIELDS} — a layout dial exists "
             f"that manifests would not record")
+    if tuple(real_manifest.STREAM_KEYS[:len(STREAM_FIELDS)]) \
+            != tuple(STREAM_FIELDS):
+        problems.append(
+            f"obs.manifest.STREAM_KEYS {real_manifest.STREAM_KEYS} does "
+            f"not lead with config.STREAM_FIELDS {STREAM_FIELDS} — a "
+            f"residency knob exists that manifests would not record")
     rec = man.emit_manifest("audit-probe", _base_cfg(), path="-")
     for k in keys + ("mesh_shape", "groups_per_device"):
         if k not in rec:
@@ -918,10 +1085,14 @@ def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
     rec2 = man.emit_manifest("audit-probe", _base_cfg(), path="-",
                              bound="hbm", attainment_pct=12.5,
                              predicted_rounds_per_sec=1.0,
-                             pack_bools=True, wire_hist=False)
+                             pack_bools=True, wire_hist=False,
+                             stream_groups=True, cohort_blocks=2,
+                             overlap_efficiency_predicted=0.75)
     for k, want in (("bound", "hbm"), ("attainment_pct", 12.5),
                     ("predicted_rounds_per_sec", 1.0),
-                    ("pack_bools", True), ("wire_hist", False)):
+                    ("pack_bools", True), ("wire_hist", False),
+                    ("stream_groups", True), ("cohort_blocks", 2),
+                    ("overlap_efficiency_predicted", 0.75)):
         if rec2.get(k) != want:
             problems.append(f"manifest dropped the caller's {k!r} value "
                             f"({rec2.get(k)!r} != {want!r})")
@@ -988,6 +1159,7 @@ def contract_problems(include_behavioral: bool = True) -> list[str]:
     out += packing_problems(include_behavioral=include_behavioral)
     out += checkpoint_problems(include_behavioral=include_behavioral)
     out += nemesis_problems()
+    out += streaming_problems(include_behavioral=include_behavioral)
     out += manifest_problems()
     out += rng_parity_problems()
     return out

@@ -40,9 +40,14 @@ from typing import Iterable
 # bodies must stay elementwise so one implementation serves the XLA
 # layouts and the kernel tiles — and the nemesis package must stay
 # free of untagged randomness: the SEARCH itself draws only hash_u32).
+# r16 adds the cohort scheduler: its host-side orchestration may
+# branch only on shapes/knobs, never on traced lane VALUES — a
+# value-dependent paging decision would make the streamed engine's
+# schedule diverge from the resident kernel it must stay bit-identical
+# to.
 DEFAULT_TARGETS = ("sim/step.py", "sim/pkernel.py", "clients/workload.py",
                    "utils/jrng.py", "nemesis/program.py",
-                   "nemesis/search.py")
+                   "nemesis/search.py", "parallel/cohort.py")
 
 # The jrng functions the elementwise rule covers (the compiled nemesis
 # evaluators — DESIGN.md §14; the rest of jrng predates the rule and is

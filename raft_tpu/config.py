@@ -75,6 +75,19 @@ def session_payload(sid: int, seq: int, val: int) -> int:
 # auditor (flipping one must change zero State pytree leaves).
 LAYOUT_FIELDS = ("pack_bools", "pack_ring", "alias_wire", "wire_hist")
 
+# Kernel RESIDENCY knobs (r16, DESIGN.md §15): fields of RaftConfig
+# that change where the wire form LIVES between chunk launches (host
+# RAM vs HBM) but never what any engine computes per tick — the same
+# layout-class contract as LAYOUT_FIELDS, kept as a separate registry
+# because the r13 manifest/backfill key lists (PACKING_KEYS ==
+# LAYOUT_FIELDS) are pinned four-wide by the contract auditor. One
+# registry, consumed by checkpoint.load (configs match modulo these —
+# a streamed run may resume a resident-layout file and vice versa), by
+# the bench/sweep manifests (obs.manifest.STREAM_KEYS lead with these
+# names), and by the contract auditor's streaming pass (flipping one
+# must change zero State pytree leaves and zero wire lanes).
+STREAM_FIELDS = ("stream_groups", "cohort_blocks")
+
 
 def _prob_to_u32(p: float) -> int:
     """Map a probability to a uint32 threshold: event iff hash < threshold.
@@ -203,6 +216,27 @@ class RaftConfig:
     alias_wire: bool = False
     wire_hist: bool = True
 
+    # Cohort-paging residency dials (DESIGN.md §15). RESIDENCY-ONLY
+    # knobs (STREAM_FIELDS below): none of them changes tick semantics
+    # — the CPU oracle and the XLA scan ignore them entirely, and the
+    # cohort scheduler (parallel/cohort.py) pages whole group blocks
+    # host<->HBM only at chunk boundaries, where the wire is already
+    # packed/unpacked, so per-tick state stays bit-identical across
+    # engines. Both default off/neutral so the default wire,
+    # checkpoints, and compiled programs are byte-identical to r14.
+    #
+    # stream_groups: hold the full fleet's wire form in host RAM and
+    #   stream cohort_blocks-sized windows of 1024-group blocks through
+    #   HBM under the unchanged fused-chunk kernel — the group ceiling
+    #   becomes host-RAM-bound (pkernel.streamed_ceiling_groups)
+    #   instead of HBM-bound (pkernel.hbm_ceiling_groups).
+    # cohort_blocks: 1024-group blocks resident per cohort window. The
+    #   double-buffered pipeline holds up to prev + current (x residency
+    #   buffers) + next windows in HBM at once — bigger windows amortize
+    #   launch overhead, smaller ones shrink the HBM footprint.
+    stream_groups: bool = False
+    cohort_blocks: int = 4
+
     # Nemesis gray-failure program (DESIGN.md §14): a tuple of 8-int
     # clauses (kind, t0, t1, group_u32, p_u32, a, b, cid) built by
     # raft_tpu/nemesis/program.py. SEMANTIC (part of the universe
@@ -293,6 +327,9 @@ class RaftConfig:
             assert 1 <= self.client_slots <= 16, (
                 "client_slots must be in [1, 16]")
             assert self.client_retry_backoff >= 1
+        assert self.cohort_blocks >= 1, (
+            "cohort_blocks must be >= 1: the cohort scheduler pages "
+            "whole 1024-group blocks and an empty window pages nothing")
         assert self.k >= 1
         assert self.election_range >= 1
         assert self.heartbeat_every >= 1

@@ -70,6 +70,16 @@ R13_MANIFEST_KEYS = ("pack_bools", "pack_ring", "alias_wire", "wire_hist")
 # obs.manifest.NEMESIS_KEYS by the auditor.
 R14_MANIFEST_KEYS = ("nemesis_program_hash", "nemesis_clauses")
 
+# Manifest keys added by the r16 cohort-paging layer (the residency
+# knobs a segment's kernel engine ran with + the predicted/measured
+# overlap efficiency of the host<->HBM pipeline, DESIGN.md §15) — same
+# present-from-birth / backfilled-as-null contract. Its own literal
+# (the registry idiom), proven equal to obs.manifest.STREAM_KEYS by
+# the auditor.
+R16_MANIFEST_KEYS = ("stream_groups", "cohort_blocks",
+                     "overlap_efficiency_predicted",
+                     "overlap_efficiency_measured")
+
 # Manifest records below this group count are smoke/--quick shapes:
 # correctness drives, not trajectory points — a 1K-group quick run's
 # rate joining the 100K series would trip (or mask) the regression
@@ -120,11 +130,13 @@ def _round_of(path: str) -> int | None:
 
 def backfill_record(rec: dict) -> dict:
     """A manifest record normalized to the current schema: the r12
-    roofline/trace keys, the r13 wire-layout keys, AND the r14 nemesis
-    keys present-but-null when the record predates them (same rule as
-    the mesh keys at r08). Returns a new dict."""
+    roofline/trace keys, the r13 wire-layout keys, the r14 nemesis
+    keys, AND the r16 streaming keys present-but-null when the record
+    predates them (same rule as the mesh keys at r08). Returns a new
+    dict."""
     out = dict(rec)
-    for k in R12_MANIFEST_KEYS + R13_MANIFEST_KEYS + R14_MANIFEST_KEYS:
+    for k in (R12_MANIFEST_KEYS + R13_MANIFEST_KEYS + R14_MANIFEST_KEYS
+              + R16_MANIFEST_KEYS):
         out.setdefault(k, None)
     return out
 

@@ -76,6 +76,20 @@ PACKING_KEYS = ("pack_bools", "pack_ring", "alias_wire", "wire_hist")
 # on read, proven both directions by the auditor's manifest pass.
 NEMESIS_KEYS = ("nemesis_program_hash", "nemesis_clauses")
 
+# r16 cohort-streaming keys: the residency knobs (config.STREAM_FIELDS
+# by name, leading) the segment's KERNEL engine ran with, plus the
+# predicted/measured overlap efficiency of the host<->HBM paging
+# pipeline (DESIGN.md §15) — top-level so a reader grading a streamed
+# rate against the §12 overlap model never digs through the config
+# dict. Present-but-null from birth (a null = "pre-streaming schema or
+# resident engine", which every pre-r16 record trivially satisfies —
+# the same rule as the mesh/roofline/packing/nemesis keys);
+# obs.history backfills them on read, proven both directions by the
+# auditor's manifest pass. Producer: obs.roofline.stream_segment_fields.
+STREAM_KEYS = ("stream_groups", "cohort_blocks",
+               "overlap_efficiency_predicted",
+               "overlap_efficiency_measured")
+
 
 def config_hash(cfg) -> str:
     """Stable short hash of the SEMANTIC config — two runs with equal
@@ -83,10 +97,13 @@ def config_hash(cfg) -> str:
     The r13 wire-layout dials (config.LAYOUT_FIELDS) are excluded:
     they never change what any engine computes, and the packed-vs-
     unpacked ablation pair for one universe must hash equal to be
-    pairable (the dials themselves are recorded via PACKING_KEYS)."""
-    from raft_tpu.config import LAYOUT_FIELDS
+    pairable (the dials themselves are recorded via PACKING_KEYS).
+    The r16 residency knobs (config.STREAM_FIELDS) follow the same
+    rule: a streamed-vs-resident pair for one universe hashes equal
+    (the knobs themselves are recorded via STREAM_KEYS)."""
+    from raft_tpu.config import LAYOUT_FIELDS, STREAM_FIELDS
     d = dataclasses.asdict(cfg)
-    for k in LAYOUT_FIELDS:
+    for k in LAYOUT_FIELDS + STREAM_FIELDS:
         d.pop(k, None)
     blob = json.dumps(d, sort_keys=True)
     return hashlib.sha256(blob.encode()).hexdigest()[:12]
@@ -124,7 +141,7 @@ def emit_manifest(segment: str, cfg, device: str | None = None,
            # roofline/trace keys follow the same rule.
            "mesh_shape": None, "groups_per_device": None,
            **{k: None for k in ROOFLINE_KEYS + PACKING_KEYS
-              + NEMESIS_KEYS}}
+              + NEMESIS_KEYS + STREAM_KEYS}}
     rec.update(fields)
     path = path or os.environ.get(MANIFEST_ENV) or DEFAULT_PATH
     if path != "-":

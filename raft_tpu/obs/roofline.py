@@ -56,8 +56,15 @@ DEFAULT_HBM_GBPS = 819.0
 # engines; override with $RAFT_TPU_VPU_GFLOPS for other parts.
 DEFAULT_VPU_GFLOPS = 14_300.0
 
+# Host<->HBM link peak for the r16 cohort-paging overlap model
+# (DESIGN.md §15): the PCIe path `jax.device_put` / host readback
+# rides. Defaults to a PCIe gen4 x16-class 32 GB/s; override with
+# $RAFT_TPU_HOST_GBPS on other hosts (gen3 x16: ~16, gen5: ~64).
+DEFAULT_HOST_GBPS = 32.0
+
 HBM_ENV = "RAFT_TPU_HBM_GBPS"
 VPU_ENV = "RAFT_TPU_VPU_GFLOPS"
+HOST_ENV = "RAFT_TPU_HOST_GBPS"
 
 # Ticks per kernel launch assumed when the caller does not say —
 # bench.py's CHUNK (its chunk loops pass the real value through).
@@ -80,6 +87,10 @@ def peak_hbm_gbps() -> float:
 
 def peak_vpu_gflops() -> float:
     return float(os.environ.get(VPU_ENV, DEFAULT_VPU_GFLOPS))
+
+
+def peak_host_gbps() -> float:
+    return float(os.environ.get(HOST_ENV, DEFAULT_HOST_GBPS))
 
 
 def engine_class(engine: str | None) -> str:
@@ -287,3 +298,92 @@ def segment_fields(cfg, n_groups: int, engine: str | None,
         "roofline": {k: (round(v, 6) if isinstance(v, float) else v)
                      for k, v in r.items()},
     }
+
+
+# --------------------------------------------- cohort-paging overlap model
+
+
+def overlap_efficiency(cfg, chunk_ticks: int | None = None,
+                       ticks_per_cohort: int | None = None,
+                       with_flight: bool = True,
+                       flops: bool = False) -> dict:
+    """Predicted overlap efficiency of the r16 cohort pipeline
+    (DESIGN.md §15): the fraction of steady-state pipeline time the
+    kernel (not the host link) owns the critical path,
+
+        efficiency = t_compute / max(t_compute, t_copy)
+
+    per cohort-window residency. `t_copy` is the window's wire crossing
+    the host link twice (h2d in, d2h out); `t_compute` is
+    `ticks_per_cohort` ticks of the §12 per-tick kernel time at the
+    window's group count — the HBM side from the reconciled byte model
+    always, the VPU side only when `flops=True` buys the probe compile
+    (off-TPU boxes skip it; the copy-vs-HBM comparison already bounds
+    the answer from below). `ticks_per_cohort` defaults to one
+    `chunk_ticks` launch per residency — the conservative cadence; a
+    soak that keeps each window resident for many launches amortizes
+    the copies linearly (the derivation the returned dict spells out).
+    1.0 == copies fully hidden; parallel/cohort.py's `stats` measures
+    the real twin (`overlap_efficiency_measured`)."""
+    from raft_tpu.sim import pkernel
+
+    chunk = chunk_ticks or DEFAULT_CHUNK_TICKS
+    resident_ticks = ticks_per_cohort or chunk
+    window_groups = cfg.cohort_blocks * pkernel.GB
+    model = _derived_model(cfg, with_flight)
+    wire = model["wire_bytes_derived"]
+    window_bytes = wire * window_groups
+    copy_s = 2.0 * window_bytes / (peak_host_gbps() * 1e9)
+    # Per-tick kernel time at the window shape (§12 byte model: the
+    # wire crosses HBM once in and once out per chunk-tick launch).
+    hbm_s = (2.0 * window_bytes / chunk) / (peak_hbm_gbps() * 1e9)
+    fm = tick_flops(cfg, window_groups) if flops else None
+    vpu_s = (fm["flops_per_tick"] / (peak_vpu_gflops() * 1e9)
+             if fm else 0.0)
+    compute_s = resident_ticks * max(hbm_s, vpu_s)
+    eff = compute_s / max(compute_s, copy_s) if copy_s > 0 else 1.0
+    return {
+        "overlap_efficiency_predicted": eff,
+        "window_groups": window_groups,
+        "window_wire_bytes": window_bytes,
+        "copy_s_per_window": copy_s,
+        "compute_s_per_window": compute_s,
+        "ticks_per_cohort": resident_ticks,
+        "chunk_ticks": chunk,
+        "peak_host_gbps": peak_host_gbps(),
+        "binding_side": "host-link" if copy_s > compute_s else "compute",
+        "flops_side_included": fm is not None,
+    }
+
+
+def stream_segment_fields(cfg, measured: float | None = None,
+                          chunk_ticks: int | None = None,
+                          ticks_per_cohort: int | None = None,
+                          with_flight: bool = True,
+                          flops: bool = False) -> dict:
+    """The r16 manifest stamp every segment carries
+    (obs.manifest.STREAM_KEYS, null-by-default in every record until
+    stamped here): the residency knobs the segment's kernel engine ran
+    with, the predicted overlap efficiency (meaningful — and computed —
+    only under cfg.stream_groups), and the measured value when the
+    cohort runner's `stats` produced one (null on CPU boxes /
+    non-streamed engines, same rule as attainment_pct). Derived against
+    the key registry so a manifest-side rename cannot drift past this
+    producer."""
+    from raft_tpu.config import STREAM_FIELDS
+    from raft_tpu.obs.manifest import STREAM_KEYS
+
+    vals = {k: getattr(cfg, k) for k in STREAM_FIELDS}
+    pred = None
+    if cfg.stream_groups:
+        pred = round(overlap_efficiency(
+            cfg, chunk_ticks=chunk_ticks, ticks_per_cohort=ticks_per_cohort,
+            with_flight=with_flight,
+            flops=flops)["overlap_efficiency_predicted"], 6)
+    vals["overlap_efficiency_predicted"] = pred
+    vals["overlap_efficiency_measured"] = (round(measured, 6)
+                                           if measured is not None else None)
+    if set(vals) != set(STREAM_KEYS):
+        raise RuntimeError(f"obs.manifest.STREAM_KEYS {STREAM_KEYS} drifted "
+                           f"from the roofline producer {set(vals)}")
+    return vals

@@ -1,8 +1,10 @@
 """Device-mesh sharding of the groups axis (DESIGN.md §5, config 5;
-§9 for the kernel wire form — raft_tpu.parallel.kmesh)."""
+§9 for the kernel wire form — raft_tpu.parallel.kmesh; §15 for the
+host<->HBM cohort paging path — raft_tpu.parallel.cohort)."""
 
+from raft_tpu.parallel.cohort import prun_streamed
 from raft_tpu.parallel.mesh import (AXIS, make_mesh, run_sharded,
                                     shard_state, state_sharding)
 
-__all__ = ["AXIS", "make_mesh", "run_sharded", "shard_state",
-           "state_sharding"]
+__all__ = ["AXIS", "make_mesh", "prun_streamed", "run_sharded",
+           "shard_state", "state_sharding"]

@@ -1,0 +1,240 @@
+"""Cohort paging: stream 1024-group blocks host<->HBM under the
+unchanged fused-chunk kernel (DESIGN.md §15).
+
+DESIGN.md §9 proved the single-chip group ceiling is an artifact of
+whole-fleet HBM residency, not of the protocol: the kernel's grid cuts
+independent SUB-sublane slices with zero collectives per chunk, so no
+block ever needs another block resident. This module exploits exactly
+that property. The full fleet's wire form (pkernel.kinit's leaves)
+lives in host RAM as per-block numpy arrays; a double-buffered pipeline
+pages `cfg.cohort_blocks`-block windows through HBM:
+
+      host RAM  [b0 b1 b2 b3 b4 b5 ...]          one wire copy of G
+                    |        ^
+              h2d copy of    |  d2h copy of
+              window i+1     |  window i-1
+                    v        |
+      HBM       [ prev | current | next ]        O(cohort_blocks)
+                          |
+                  unchanged pallas_call(s)       chunk ticks each
+
+While the kernel runs window i, the host->HBM copy of window i+1 and
+the HBM->host copy of window i-1 are in flight (JAX async dispatch:
+`jax.device_put` and the launches return immediately; only the
+`np.asarray` readback blocks). HBM holds at most `_stream_windows(cfg)`
+windows instead of the whole fleet, so the group ceiling becomes
+host-RAM-bound (`pkernel.streamed_ceiling_groups`, $RAFT_TPU_HOST_RAM_
+BYTES) instead of HBM-bound.
+
+Bit-identity is free by construction: paging happens only at chunk
+boundaries — where `_pack_wire`/`_unpack_wire` already run — and every
+window's launch is the same `pallas_call` over the same folded
+[..., GS, LANE] leaves (`group_id` rides the wire, so the seed streams
+of a block are identical wherever it is resident). The fori-loop and
+every bit-identity gate stay layout-blind; `prun_streamed` is pinned
+bit-identical to `pkernel.prun` AND the XLA path by
+tests/test_streaming.py and the multichip sweep's three-way gate.
+
+Gated behind `cfg.stream_groups` / `cfg.cohort_blocks`
+(config.STREAM_FIELDS — residency-class knobs, default off, excluded
+from the checkpoint semantic match like LAYOUT_FIELDS).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import numpy as np
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.obs.recorder import Flight
+from raft_tpu.sim import pkernel
+from raft_tpu.sim.pkernel import GB, LANE, SUB
+from raft_tpu.sim.run import Metrics
+from raft_tpu.sim.state import State
+
+# Engine string of the streamed runner. obs.roofline.engine_class
+# prefix-matches "pallas" (same residency byte model per launch); the
+# sweeps' verdict columns and chunk spans carry it verbatim.
+ENGINE = "pallas-streamed"
+
+
+def _host_device():
+    """The host CPU jax device, or None when no CPU backend exists —
+    kinit/kfinish (the one-time whole-fleet conversions) are pinned to
+    it so the full wire never materializes in HBM even on a TPU box."""
+    import jax
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except Exception:
+        return None
+
+
+def _on_host():
+    import jax
+    dev = _host_device()
+    return jax.default_device(dev) if dev is not None \
+        else contextlib.nullcontext()
+
+
+def host_wire(cfg: RaftConfig, st: State, metrics: Metrics | None = None,
+              flight: Flight | None = None):
+    """(host_leaves, g): the fleet's full wire form as HOST numpy
+    arrays — `pkernel.kinit` run on the host backend, each leaf pulled
+    out of jax. This is the pinned store the pipeline pages from; it is
+    mutated in place by `stream_ticks`."""
+    with _on_host():
+        leaves, g = pkernel.kinit(cfg, st, metrics, flight)
+    # np.array, not np.asarray: jax buffers surface as READ-ONLY views
+    # and the store must accept _writeback's in-place window drains.
+    return [np.array(leaf) for leaf in leaves], g
+
+
+def cohort_windows(cfg: RaftConfig, host_leaves) -> list:
+    """[(s0, s1), ...] sublane windows of the folded group axis
+    (dim -2, the axis `kleaf_spec` shards): `cohort_blocks` whole
+    SUB-sublane blocks each, the last window taking the remainder."""
+    gs = host_leaves[0].shape[-2]
+    if gs % SUB:
+        raise ValueError(f"wire leaves carry {gs} sublanes — not whole "
+                         f"{SUB}-sublane blocks; host_wire pads to {GB}")
+    step = cfg.cohort_blocks * SUB
+    return [(s0, min(s0 + step, gs)) for s0 in range(0, gs, step)]
+
+
+def _window(host_leaves, s0: int, s1: int):
+    """Device-put one cohort window (h2d of every leaf's [s0:s1)
+    sublanes — async dispatch; nothing blocks here)."""
+    import jax
+    return tuple(jax.device_put(np.ascontiguousarray(
+        leaf[..., s0:s1, :])) for leaf in host_leaves)
+
+
+def stream_ticks(cfg: RaftConfig, host_leaves, g: int, t0: int,
+                 n_ticks: int, interpret: bool = False,
+                 chunk_ticks: int | None = None,
+                 stats: dict | None = None):
+    """Advance the WHOLE host-resident fleet by `n_ticks` ticks (from
+    absolute tick `t0`), paging one cohort window at a time: window i+1
+    is prefetched (h2d) and window i-1 drained (d2h) while window i's
+    launches run — the double-buffered pipeline of DESIGN.md §15.
+    Mutates `host_leaves` in place and returns it.
+
+    Each window runs ceil(n_ticks / chunk_ticks) launches of the
+    unchanged `pkernel.kstep` (one compiled program reused across
+    windows — every window is the same leaf shapes except possibly a
+    smaller last one). `chunk_ticks=None` means one launch per window.
+    With a tracer installed every launch leaves one span on the
+    "pallas-streamed" lane (cohort + block window attached), and the
+    soak heartbeat snapshots the streamed wire lanes after each
+    window's last launch (obs.trace.heartbeat_wire), so a 10M-group
+    soak is observable mid-flight.
+
+    `stats`, when passed, accumulates the measured pipeline split:
+    h2d_s / compute_s / d2h_s / wall_s / launches / cohorts and
+    `overlap_efficiency_measured` = compute_s / wall_s (1.0 == copies
+    fully hidden behind compute; obs.roofline.overlap_efficiency is the
+    predicted twin)."""
+    import jax
+
+    from raft_tpu.obs import trace as obs_trace
+
+    if n_ticks <= 0:
+        return host_leaves
+    chunk = chunk_ticks or n_ticks
+    wins = cohort_windows(cfg, host_leaves)
+    t_h2d = t_compute = t_d2h = 0.0
+    launches = 0
+    wall0 = time.perf_counter()
+    tic = time.perf_counter()
+    nxt = _window(host_leaves, *wins[0])
+    t_h2d += time.perf_counter() - tic
+    pending = None   # (evolved_leaves, s0, s1) of window i-1, d2h owed
+    for ci, (s0, s1) in enumerate(wins):
+        cur = nxt
+        if ci + 1 < len(wins):
+            tic = time.perf_counter()
+            nxt = _window(host_leaves, *wins[ci + 1])   # prefetch i+1
+            t_h2d += time.perf_counter() - tic
+        g_win = min(g - s0 * LANE, (s1 - s0) * LANE)
+        at = t0
+        while at < t0 + n_ticks:
+            n = min(chunk, t0 + n_ticks - at)
+            with obs_trace.chunk_span(ENGINE, at, n, cohort=ci,
+                                      blocks=(s1 - s0) // SUB,
+                                      interpret=bool(interpret)):
+                cur = pkernel.kstep(cfg, cur, at, n, interpret=interpret)
+            launches += 1
+            at += n
+        obs_trace.heartbeat_wire(f"{ENGINE}:c{ci}", t0 + n_ticks, cfg,
+                                 cur, g_win)
+        if pending is not None:
+            tic = time.perf_counter()
+            _writeback(host_leaves, *pending)   # d2h of i-1 overlaps i
+            t_d2h += time.perf_counter() - tic
+        tic = time.perf_counter()
+        jax.block_until_ready(cur)
+        t_compute += time.perf_counter() - tic
+        pending = (cur, s0, s1)
+    tic = time.perf_counter()
+    _writeback(host_leaves, *pending)
+    t_d2h += time.perf_counter() - tic
+    wall = time.perf_counter() - wall0
+    if stats is not None:
+        stats["cohorts"] = stats.get("cohorts", 0) + len(wins)
+        stats["launches"] = stats.get("launches", 0) + launches
+        stats["h2d_s"] = stats.get("h2d_s", 0.0) + t_h2d
+        stats["compute_s"] = stats.get("compute_s", 0.0) + t_compute
+        stats["d2h_s"] = stats.get("d2h_s", 0.0) + t_d2h
+        stats["wall_s"] = stats.get("wall_s", 0.0) + wall
+        stats["overlap_efficiency_measured"] = (
+            stats["compute_s"] / stats["wall_s"] if stats["wall_s"] > 0
+            else None)
+    return host_leaves
+
+
+def _writeback(host_leaves, window_leaves, s0: int, s1: int):
+    """d2h: drain one evolved window back into the host store (the
+    np.asarray blocks on the window's launches + transfer)."""
+    for host, dev in zip(host_leaves, window_leaves):
+        host[..., s0:s1, :] = np.asarray(dev)
+
+
+def prun_streamed(cfg: RaftConfig, st: State, n_ticks: int, t0: int = 0,
+                  metrics: Metrics | None = None, interpret: bool = False,
+                  flight: Flight | None = None,
+                  chunk_ticks: int | None = None,
+                  stats: dict | None = None):
+    """Drop-in for `pkernel.prun` / `kmesh.prun_sharded` on streamed
+    configs: same (State, Metrics[, Flight]) out, same bits — the
+    cohort pipeline between the same kinit/kfinish conversions. Raises
+    ValueError on unsupported shapes (supported() under
+    cfg.stream_groups budgets host RAM for G and HBM only for the
+    cohort window). Pass `stats` (a dict) to receive the measured
+    pipeline split, `chunk_ticks` to split each window's residency
+    into multiple launches (bench cadence)."""
+    g = st.alive_prev.shape[0]
+    wf = flight is not None
+    scfg = cfg if cfg.stream_groups else None
+    if scfg is None:
+        import dataclasses
+        scfg = dataclasses.replace(cfg, stream_groups=True)
+    if not pkernel.supported(scfg, n_groups=g, with_flight=wf):
+        raise ValueError(
+            "cohort: shape unsupported (k > 30, VMEM footprint "
+            f"{pkernel.kernel_vmem_bytes(cfg)} B > "
+            f"{pkernel.VMEM_LIMIT_BYTES} B, cohort window "
+            f"{pkernel.cohort_hbm_bytes(cfg, wf)} B > "
+            f"{pkernel.HBM_LIMIT_BYTES} B HBM, or host wire "
+            f"{pkernel.host_bytes(cfg, g, wf)} B > "
+            f"{pkernel.HOST_RAM_LIMIT_BYTES} B host RAM)")
+    host_leaves, g = host_wire(cfg, st, metrics, flight)
+    stream_ticks(cfg, host_leaves, g, t0, n_ticks, interpret=interpret,
+                 chunk_ticks=chunk_ticks, stats=stats)
+    with _on_host():
+        leaves = tuple(map(np.asarray, host_leaves))
+        if flight is None:
+            return pkernel.kfinish(cfg, leaves, g, metrics)
+        st2, met2 = pkernel.kfinish(cfg, leaves, g, metrics)
+        return st2, met2, pkernel.kflight(cfg, leaves, g)

@@ -163,9 +163,13 @@ def load(path, cfg: Optional[RaftConfig] = None, sharding=None
             # change what any engine computes, and checkpoints store
             # the layout-free State pytree — a packed run may resume an
             # unpacked file (incl. every pre-r13 file) and vice versa,
-            # so they are excluded from the semantic match.
-            from raft_tpu.config import LAYOUT_FIELDS
-            for k in LAYOUT_FIELDS:
+            # so they are excluded from the semantic match. The r16
+            # RESIDENCY knobs (config.STREAM_FIELDS) follow the same
+            # rule: a streamed run may resume a resident-layout file
+            # (incl. every pre-r16 file) and vice versa — paging only
+            # moves where the wire lives between chunk launches.
+            from raft_tpu.config import LAYOUT_FIELDS, STREAM_FIELDS
+            for k in LAYOUT_FIELDS + STREAM_FIELDS:
                 saved.pop(k, None)
                 want.pop(k, None)
             if saved != want:

@@ -137,6 +137,15 @@ VMEM_LIMIT_BYTES = 100 * 1024 * 1024   # budget passed to the compiler
 import os as _os
 HBM_LIMIT_BYTES = int(_os.environ.get("RAFT_TPU_HBM_BYTES",
                                       16 * 1024 ** 3))
+# Host-RAM budget for the STREAMED residency model (DESIGN.md §15):
+# under cfg.stream_groups the full fleet's wire form lives in host RAM
+# and only O(cohort_blocks) 1024-group blocks are HBM-resident, so the
+# group ceiling is bounded by this figure, not by HBM. Defaults to
+# 64 GiB — conservative for a TPU host VM (v4/v5 hosts carry hundreds
+# of GB); a driver on a bigger/smaller host sets $RAFT_TPU_HOST_RAM_BYTES.
+# Read ONCE at import, exactly like the HBM budget above.
+HOST_RAM_LIMIT_BYTES = int(_os.environ.get("RAFT_TPU_HOST_RAM_BYTES",
+                                           64 * 1024 ** 3))
 
 
 def _kind_words(cfg: RaftConfig, kind: str) -> int:
@@ -310,6 +319,52 @@ def hbm_ceiling_groups(cfg: RaftConfig, n_devices: int = 1,
     return (HBM_LIMIT_BYTES // per_block) * GB * n_devices
 
 
+def _stream_windows(cfg: RaftConfig) -> int:
+    """Peak HBM-resident cohort windows of the double-buffered pipeline
+    (parallel/cohort.py): the PREVIOUS cohort awaiting its HBM->host
+    copy, the CURRENT one under the kernel (x residency buffers — in
+    AND out copies live across a launch unless alias_wire donates), and
+    the NEXT one prefetched host->HBM."""
+    return 2 + _residency_buffers(cfg)
+
+
+def host_bytes(cfg: RaftConfig, n_groups: int,
+               with_flight: bool = True) -> int:
+    """Host-RAM bytes a streamed run pins: ONE copy of the full fleet's
+    wire form, padded to whole 1024-group blocks (kinit's padding rule
+    — the host arrays ARE kinit's leaves, fetched once)."""
+    padded = (-(-n_groups // GB)) * GB
+    return 4 * wire_words_per_group(cfg, with_flight) * padded
+
+
+def cohort_hbm_bytes(cfg: RaftConfig, with_flight: bool = True) -> int:
+    """Peak per-device HBM bytes the streamed pipeline holds: the
+    cohort window (cohort_blocks whole blocks) times the pipeline's
+    live-window count (`_stream_windows`) — O(cohort_blocks), never
+    O(G). This replaces `hbm_bytes` as the HBM side of `supported()`
+    under cfg.stream_groups."""
+    window = cfg.cohort_blocks * GB
+    return (_stream_windows(cfg) * 4
+            * wire_words_per_group(cfg, with_flight) * window)
+
+
+def streamed_ceiling_groups(cfg: RaftConfig, n_devices: int = 1,
+                            with_flight: bool = True) -> int:
+    """Largest group count `supported()` admits under cfg.stream_groups
+    on `n_devices`: host-RAM-bound (ONE wire copy per group in host
+    RAM), in whole 1024-group blocks, consistent with `host_bytes`'s
+    padding — same exact-boundary contract as `hbm_ceiling_groups`,
+    budget $RAFT_TPU_HOST_RAM_BYTES instead of $RAFT_TPU_HBM_BYTES.
+    The cohort window must also fit HBM (`cohort_hbm_bytes`) or no
+    group count is admitted at all. The single source for every
+    printed/emitted streamed ceiling (layout_probe, multichip_sweep,
+    analysis/bytemodel)."""
+    if cohort_hbm_bytes(cfg, with_flight) > HBM_LIMIT_BYTES:
+        return 0
+    per_block = 4 * wire_words_per_group(cfg, with_flight) * GB
+    return (HOST_RAM_LIMIT_BYTES // per_block) * GB * n_devices
+
+
 def supported(cfg: RaftConfig, n_groups: int | None = None,
               n_devices: int = 1, with_flight: bool = True) -> bool:
     """Every batched-path feature is in-kernel: fault classes,
@@ -330,9 +385,26 @@ def supported(cfg: RaftConfig, n_groups: int | None = None,
     what the multichip sweep uses to mark unsupported grid cells.
     `with_flight=False` budgets a flight-ring-less run (prun passes
     the actual flight argument through); the budget itself defaults to
-    16 GiB and follows $RAFT_TPU_HBM_BYTES on larger-HBM parts."""
+    16 GiB and follows $RAFT_TPU_HBM_BYTES on larger-HBM parts.
+
+    Under `cfg.stream_groups` (DESIGN.md §15) the HBM side of the
+    predicate changes residency scheme: only the cohort window
+    (`cohort_hbm_bytes`, O(cohort_blocks)) must fit HBM, and `n_groups`
+    is instead budgeted against host RAM (`host_bytes` per device vs
+    $RAFT_TPU_HOST_RAM_BYTES) — the ceiling `streamed_ceiling_groups`
+    publishes is the exact boundary of this branch."""
     if not (cfg.k <= 30 and kernel_vmem_bytes(cfg) <= VMEM_LIMIT_BYTES):
         return False
+    if cfg.stream_groups:
+        # Streamed residency (DESIGN.md §15): the cohort window must fit
+        # HBM whatever G is; G itself is bounded by host RAM (one wire
+        # copy of the whole padded fleet), not by HBM.
+        if cohort_hbm_bytes(cfg, with_flight) > HBM_LIMIT_BYTES:
+            return False
+        if n_groups is None:
+            return True
+        return (host_bytes(cfg, -(-n_groups // n_devices), with_flight)
+                <= HOST_RAM_LIMIT_BYTES)
     if n_groups is None:
         return True
     return hbm_bytes(cfg, n_groups, n_devices, with_flight) \

@@ -112,19 +112,33 @@ def sweep_configs(base_seed: int, clients: bool = False,
 
 
 def run_universe(cfg: RaftConfig, n_groups: int, ticks: int,
-                 interpret: bool, devices: int = 1):
+                 interpret: bool, devices: int = 1,
+                 stream: bool = False):
     """(ok, detail, seconds, unsafe) for one universe's kernel-vs-XLA
     check. `unsafe` counts groups whose per-tick safety bit dropped —
     each universe doubles as an n_groups x ticks safety soak, so the
     sweep log is soak evidence, not just divergence evidence. With
     `devices > 1` the kernel half runs shard_map'd over a device mesh
     (parallel/kmesh.py) — the XLA reference stays unsharded, so the
-    comparison also certifies that sharding is invisible."""
+    comparison also certifies that sharding is invisible. With
+    `stream` (the `--stream` axis, ISSUE r16) the kernel half runs
+    through the cohort scheduler (parallel/cohort.py) at
+    cohort_blocks=1 and >=2 launches per window, so the comparison
+    certifies that host<->HBM paging is invisible too."""
     t0 = time.perf_counter()
     st0 = sim.init(cfg, n_groups=n_groups)
     stx, mx = run(cfg, st0, ticks, 0,
                   metrics_init(n_groups, clients=cfg.clients_u32 != 0))
-    if devices > 1:
+    if stream:
+        import dataclasses
+
+        from raft_tpu.parallel import cohort
+        scfg = dataclasses.replace(cfg, stream_groups=True,
+                                   cohort_blocks=1)
+        stp, mp = cohort.prun_streamed(scfg, st0, ticks,
+                                       interpret=interpret,
+                                       chunk_ticks=max(1, ticks // 2))
+    elif devices > 1:
         from raft_tpu import parallel
         from raft_tpu.parallel import kmesh
         mesh = parallel.make_mesh(devices)
@@ -249,8 +263,18 @@ def main():
                     "program (slow-follower + flaky-link mix) through "
                     "oracle, XLA, and the kernel over a >=120-tick "
                     "faulted universe; rc != 0 on any divergence")
+    ap.add_argument("--stream", action="store_true",
+                    help="run every universe's kernel through the r16 "
+                    "cohort scheduler (parallel/cohort.py, "
+                    "cohort_blocks=1, >=2 launches per window) — the "
+                    "streamed x feature x fault cells, same full "
+                    "State+Metrics bit-identity gate against the "
+                    "resident XLA reference")
     args = ap.parse_args()
     _check_pairwise(ROWS)
+    if args.stream and args.devices > 1:
+        ap.error("--stream is single-device (host paging composes per "
+                 "chip; the sharded path stays resident)")
 
     if args.devices > 1 and len(jax.devices()) < args.devices:
         if jax.devices()[0].platform == "tpu":
@@ -301,6 +325,8 @@ def main():
             feats += "+clients"
         if args.packed:
             feats += "+packed"
+        if args.stream:
+            feats += "+streamed"
         # Sweep universes carry no flight ring: budget the flight-off
         # model, matching run_universe's flightless prun/prun_sharded.
         if not pkernel.supported(cfg, args.groups, args.devices,
@@ -309,7 +335,8 @@ def main():
                   f"shape (skipped)", flush=True)
             continue
         ok, detail, dt, unsafe = run_universe(cfg, args.groups, args.ticks,
-                                              args.interpret, args.devices)
+                                              args.interpret, args.devices,
+                                              stream=args.stream)
         tag = "ok" if ok else "DIVERGED"
         safe_tag = "ok" if unsafe == 0 else f"VIOLATED({unsafe} groups)"
         print(f"[{n}] seed={cfg.seed} k={cfg.k} L={cfg.log_cap} "

@@ -126,6 +126,33 @@ def bytes_per_group_report(cfg=None):
         print(f"  x{d} devices (kernel, flight on): "
               f"{pkernel.hbm_ceiling_groups(cfg, n_devices=d):>9,d} groups")
 
+    # Streamed (cohort-paged) ceiling (DESIGN.md §15): with
+    # stream_groups on, HBM holds only the resident cohort window and
+    # host RAM becomes the binding resource — the ceiling is
+    # host_limit // wire-bytes-per-block, whole blocks, and the model
+    # is pinned to the exact supported() boundary just like the static
+    # one above.
+    import dataclasses as _sdc
+    scfg = _sdc.replace(cfg, stream_groups=True)
+    host = pkernel.HOST_RAM_LIMIT_BYTES
+    print(f"streamed (cohort-paged) G ceiling per {host >> 30} GiB host "
+          f"RAM (cohort_blocks={scfg.cohort_blocks}, "
+          f"{pkernel._stream_windows(scfg)} HBM windows of "
+          f"{pkernel.cohort_hbm_bytes(scfg) >> 20} MiB — DESIGN.md §15):")
+    for fl_label, fl in (("flight on", True), ("flight off", False)):
+        ceil = pkernel.streamed_ceiling_groups(scfg, with_flight=fl)
+        boundary = (pkernel.supported(scfg, n_groups=ceil, with_flight=fl)
+                    and not pkernel.supported(scfg, n_groups=ceil
+                                              + pkernel.GB, with_flight=fl))
+        print(f"  kernel wire ({fl_label}): {ceil:>12,d} groups "
+              f"({'exact supported() boundary' if boundary else 'BOUNDARY DRIFT'})")
+    adcfg = _sdc.replace(scfg, pack_bools=True, pack_ring=True,
+                         alias_wire=True, wire_hist=False)
+    ad = pkernel.streamed_ceiling_groups(adcfg, with_flight=False)
+    st = pkernel.hbm_ceiling_groups(adcfg, with_flight=False)
+    print(f"  all dials, flight off:   {ad:>12,d} groups "
+          f"(vs {st:,d} static resident = {ad / st:.2f}x)")
+
     # Client-traffic delta (DESIGN.md §10): the headline config with
     # the bench client-SLO segment's workload knobs on.
     import dataclasses

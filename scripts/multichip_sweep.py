@@ -56,9 +56,29 @@ def log(msg):
 def _predicted(cfg):
     import dataclasses
 
-    from raft_tpu.config import LAYOUT_FIELDS
+    from raft_tpu.config import LAYOUT_FIELDS, STREAM_FIELDS
     from raft_tpu.sim import pkernel
     buffers = pkernel._residency_buffers(cfg)
+    # r16 streamed residency (DESIGN.md §15): the host-RAM-bound
+    # ceilings the cohort scheduler models for this layout, next to the
+    # static ones so the artifact carries both sides of the ablation.
+    scfg = dataclasses.replace(cfg, stream_groups=True)
+    sdials = dataclasses.replace(scfg, pack_bools=True, pack_ring=True,
+                                 alias_wire=True, wire_hist=False)
+    streamed = {
+        "knobs": {k: getattr(cfg, k) for k in STREAM_FIELDS},
+        "host_ram_limit_bytes": pkernel.HOST_RAM_LIMIT_BYTES,
+        "stream_windows": pkernel._stream_windows(scfg),
+        "cohort_hbm_bytes_no_flight":
+            pkernel.cohort_hbm_bytes(scfg, with_flight=False),
+        "ceiling_groups_no_flight":
+            pkernel.streamed_ceiling_groups(scfg, with_flight=False),
+        "ceiling_groups_all_dials_no_flight":
+            pkernel.streamed_ceiling_groups(sdials, with_flight=False),
+        "model": "host RAM holds ONE wire copy of G (whole blocks); "
+                 "HBM holds only stream_windows cohort windows — see "
+                 "scripts/layout_probe.py for the boundary pins",
+    }
     out = {
         "wire_bytes_per_group":
             4 * pkernel.wire_words_per_group(cfg, with_flight=True),
@@ -85,6 +105,7 @@ def _predicted(cfg):
                  f"({'donated' if buffers == 1 else 'in + out buffers'}) "
                  "x padded groups; see scripts/layout_probe.py "
                  "--ablate for the per-encoding breakdown",
+        "streamed": streamed,
     }
     return out
 
@@ -316,6 +337,54 @@ def interpret_gate(n_devices: int, dials: dict | None = None):
             "wall_s": round(time.perf_counter() - t0, 3)}
 
 
+def streamed_gate(dials: dict | None = None):
+    """The r16 cohort-paging differential a CPU box can afford
+    (DESIGN.md §15): interpret mode at the shared faulted-64 shape,
+    THREE-WAY — the streamed engine (parallel/cohort.py,
+    cohort_blocks=1, two launches per window) vs the resident kernel
+    vs the XLA scan, full State + Metrics bit-identical. The streamed
+    column of this sweep's artifact: paging must be invisible before
+    any streamed throughput number means anything."""
+    import dataclasses
+
+    from raft_tpu import sim
+    from raft_tpu.parallel import cohort
+    from raft_tpu.sim import pkernel
+    from raft_tpu.sim.run import run, unsafe_groups
+    from raft_tpu.utils.trees import trees_equal_why
+
+    cfg = _dry_cfg()
+    if dials:
+        cfg = dataclasses.replace(cfg, **dials)
+    scfg = dataclasses.replace(cfg, stream_groups=True, cohort_blocks=1)
+    ticks = 48
+    t0 = time.perf_counter()
+    st0 = sim.init(cfg)
+    st_s, m_s = cohort.prun_streamed(scfg, st0, ticks, interpret=True,
+                                     chunk_ticks=ticks // 2)
+    verdicts = {}
+    st_k, m_k = pkernel.prun(cfg, st0, ticks, interpret=True)
+    ok_s, why_s = trees_equal_why(st_k, st_s)
+    ok_m, why_m = trees_equal_why(m_k, m_s,
+                                  names=list(type(m_k)._fields))
+    verdicts["vs_kernel_resident"] = bool(ok_s and ok_m)
+    if not (ok_s and ok_m):
+        log(f"    resident-kernel mismatch: {why_s or why_m}")
+    st_x, m_x = run(cfg, st0, ticks)
+    m_x = _hist_comparable(cfg, m_x, m_s)
+    ok_s, why_s = trees_equal_why(st_x, st_s)
+    ok_m, why_m = trees_equal_why(m_x, m_s,
+                                  names=list(type(m_x)._fields))
+    verdicts["vs_xla"] = bool(ok_s and ok_m)
+    if not (ok_s and ok_m):
+        log(f"    xla mismatch: {why_s or why_m}")
+    return {"mode": "interpret-streamed", "engine": cohort.ENGINE,
+            "groups": cfg.n_groups, "ticks": ticks, "cohort_blocks": 1,
+            "state_identical": all(verdicts.values()), **verdicts,
+            "safety_ok": unsafe_groups(m_s) == 0,
+            "wall_s": round(time.perf_counter() - t0, 3)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="MULTICHIP_r07.json")
@@ -420,6 +489,27 @@ def main():
                     "status": f"error: {type(e).__name__}: {e}"}
             log(f"  interpret gate FAILED: {gate['status']}")
 
+    sgate = None
+    if not on_tpu:
+        # The streamed column (r16): three-way state_identical —
+        # streamed vs resident kernel vs XLA — at the shared
+        # faulted-64 shape, interpret mode.
+        log(f"interpret-mode streamed-engine gate (64 groups, 3-way"
+            f"{', dialed layout' if dialed else ''}):")
+        try:
+            sgate = streamed_gate(dials if dialed else None)
+            log(f"  state_identical={sgate['state_identical']} "
+                f"(vs_kernel_resident={sgate['vs_kernel_resident']} "
+                f"vs_xla={sgate['vs_xla']}) "
+                f"safety_ok={sgate['safety_ok']} ({sgate['wall_s']}s)")
+        except Exception as e:
+            # Same tri-state convention as the interpret gate: an
+            # ERROR is recorded evidence, never a divergence verdict.
+            sgate = {"mode": "interpret-streamed",
+                     "state_identical": None, "safety_ok": None,
+                     "status": f"error: {type(e).__name__}: {e}"}
+            log(f"  streamed gate FAILED: {sgate['status']}")
+
     out = {
         "schema": 1,
         "source": "scripts/multichip_sweep.py",
@@ -435,6 +525,7 @@ def main():
         "predicted": _predicted(cfg),
         "grid": grid,
         "interpret_gate": gate,
+        "streamed_gate": sgate,
     }
     path = args.out
     if not os.path.isabs(path):
@@ -454,6 +545,9 @@ def main():
     if gate is not None and (gate["state_identical"] is False
                              or gate["safety_ok"] is False):
         bad.append(gate)   # the only sharded-KERNEL verdict on a CPU box
+    if sgate is not None and (sgate["state_identical"] is False
+                              or sgate["safety_ok"] is False):
+        bad.append(sgate)   # the streamed column's verdict
     return 1 if bad else 0
 
 

@@ -1,0 +1,325 @@
+"""The r16 cohort-paging layer (DESIGN.md §15): stream 1024-group
+blocks host<->HBM under the unchanged fused-chunk kernel.
+
+The contract under test: the residency knobs (config.STREAM_FIELDS)
+are RESIDENCY-ONLY. With stream_groups on, the streamed runner must
+stay bit-identical to the resident kernel AND the XLA path on the full
+State + Metrics (+ flight ring) — including the multi-cohort shape
+where G spans several blocks and each window runs several launches;
+with it off, every r14 byte pin (8,308 / 11,056 B/group) and the
+static ceiling are untouched. The modeled streamed ceiling must be the
+exact supported() boundary against host RAM (>= 10M groups/chip at the
+all-dials layout vs 4,836,352 static), checkpoints must load across
+residency in both directions, and every manifest record must carry the
+STREAM_KEYS from birth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+
+import numpy as np
+import pytest
+
+import conftest  # noqa: F401  (pins the CPU platform before jax loads)
+
+from raft_tpu.config import STREAM_FIELDS, RaftConfig
+from raft_tpu.parallel import cohort
+from raft_tpu.sim import checkpoint, pkernel, state
+from raft_tpu.sim.run import metrics_init, run
+from raft_tpu.utils.trees import trees_equal, trees_equal_why
+
+# The shared fast-tier differential universe (kmesh.faulted_64_cfg's
+# shape): crash + partition + drop churn so restarts, truncations and
+# ring churn actually cross the cohort windows.
+FAULTED = RaftConfig(n_groups=64, k=3, seed=23, drop_prob=0.05,
+                     crash_prob=0.2, crash_epoch=16, partition_prob=0.2,
+                     partition_epoch=16, log_cap=8, compact_every=4)
+
+STREAMED = dict(stream_groups=True, cohort_blocks=1)
+ALL_DIALS = dict(pack_bools=True, pack_ring=True, alias_wire=True,
+                 wire_hist=False)
+
+
+def _headline():
+    return RaftConfig(seed=42)
+
+
+def _clients():
+    return dataclasses.replace(_headline(), sessions=True, cmds_per_tick=0,
+                               client_rate=0.2, client_slots=4,
+                               client_retry_backoff=8)
+
+
+# ----------------------------------------------------- residency model
+
+
+def test_stream_knobs_default_off_and_wire_blind():
+    """Default-off is byte-identical r14: stream_groups defaults False,
+    and flipping the residency knobs moves ZERO wire bytes — the
+    8,308 / 11,056 B/group pins hold with the knobs on, and the static
+    resident ceiling keeps its DESIGN.md §9 figure."""
+    assert RaftConfig().stream_groups is False
+    for cfg, pin in ((_headline(), 8_308), (_clients(), 11_056)):
+        on = dataclasses.replace(cfg, stream_groups=True, cohort_blocks=2)
+        assert 4 * pkernel.wire_words_per_group(cfg) == pin
+        assert 4 * pkernel.wire_words_per_group(on) == pin
+        assert pkernel._n_state_leaves(on) == pkernel._n_state_leaves(cfg)
+    assert pkernel.hbm_ceiling_groups(_headline()) == 1_033_216
+    assert pkernel.hbm_ceiling_groups(
+        dataclasses.replace(_headline(), **ALL_DIALS),
+        with_flight=False) == 4_836_352
+
+
+def test_streamed_ceiling_breaks_10m_and_is_exact_boundary():
+    """THE r16 acceptance pin: the modeled streamed ceiling clears 10M
+    groups/chip at the all-dials layout (vs 4,836,352 static resident),
+    it is host-RAM arithmetic in whole blocks, and — like every ceiling
+    in this repo — the EXACT supported() boundary: one more block tips
+    it."""
+    scfg = dataclasses.replace(_headline(), stream_groups=True, **ALL_DIALS)
+    ceil = pkernel.streamed_ceiling_groups(scfg, with_flight=False)
+    assert ceil >= 10_000_000
+    static = pkernel.hbm_ceiling_groups(scfg, with_flight=False)
+    assert ceil > 2 * static
+    wire = 4 * pkernel.wire_words_per_group(scfg, with_flight=False)
+    assert ceil == (pkernel.HOST_RAM_LIMIT_BYTES
+                    // (wire * pkernel.GB)) * pkernel.GB
+    assert ceil % pkernel.GB == 0
+    assert pkernel.supported(scfg, n_groups=ceil, with_flight=False)
+    assert not pkernel.supported(scfg, n_groups=ceil + pkernel.GB,
+                                 with_flight=False)
+    # The cohort window (not the fleet) is what must fit HBM.
+    assert pkernel.cohort_hbm_bytes(scfg, with_flight=False) \
+        <= pkernel.HBM_LIMIT_BYTES
+    assert pkernel._stream_windows(scfg) \
+        == 2 + pkernel._residency_buffers(scfg)
+
+
+def test_streamed_supported_budgets_host_ram_not_hbm():
+    """supported() under stream_groups answers for a G the resident
+    model refuses: group counts far past the HBM ceiling are fine while
+    the host wire fits, and the host budget still refuses somewhere."""
+    cfg = _headline()
+    scfg = dataclasses.replace(cfg, stream_groups=True)
+    g = 4_000_000   # ~4x the static flight-off ceiling
+    assert not pkernel.supported(cfg, n_groups=g, with_flight=False)
+    assert pkernel.supported(scfg, n_groups=g, with_flight=False)
+    too_big = pkernel.streamed_ceiling_groups(
+        scfg, with_flight=False) + pkernel.GB
+    assert not pkernel.supported(scfg, n_groups=too_big, with_flight=False)
+
+
+def test_byte_model_reconciles_streamed_ceiling():
+    """The engine-contract auditor's derived model agrees: the streamed
+    ceiling re-derives from dtype x shape at every audited layout and
+    is boundary-exact (the same three-accounting rule as the static
+    ceiling)."""
+    from raft_tpu.analysis import bytemodel
+
+    for label, cfg in bytemodel.audit_cfgs():
+        model = bytemodel.derived_wire_model(cfg)
+        assert model["problems"] == [], (label, model["problems"])
+        s = model["hbm"]["streamed"]
+        assert s["boundary_exact"], label
+        assert s["ceiling_groups"] % pkernel.GB == 0, label
+        assert s["window_hbm_bytes"] <= pkernel.HBM_LIMIT_BYTES, label
+
+
+def test_overlap_efficiency_model_and_segment_fields():
+    """The overlap model is a sane fraction, the manifest producer
+    stamps exactly obs.manifest.STREAM_KEYS, predicted is null on
+    resident segments and computed on streamed ones, and a measured
+    value passes through."""
+    from raft_tpu.obs import roofline
+    from raft_tpu.obs.manifest import STREAM_KEYS
+
+    scfg = dataclasses.replace(_headline(), stream_groups=True)
+    pred = roofline.overlap_efficiency(scfg, chunk_ticks=200)
+    assert 0.0 < pred["overlap_efficiency_predicted"] <= 1.0
+    assert pred["binding_side"] in ("host-link", "compute")
+    # Keeping a window resident longer amortizes its two copies.
+    longer = roofline.overlap_efficiency(scfg, chunk_ticks=200,
+                                         ticks_per_cohort=2_000)
+    assert longer["overlap_efficiency_predicted"] \
+        >= pred["overlap_efficiency_predicted"]
+    off = roofline.stream_segment_fields(_headline())
+    assert set(off) == set(STREAM_KEYS)
+    assert off["stream_groups"] is False
+    assert off["overlap_efficiency_predicted"] is None
+    assert off["overlap_efficiency_measured"] is None
+    on = roofline.stream_segment_fields(scfg, measured=0.8125,
+                                        chunk_ticks=200)
+    assert on["stream_groups"] is True
+    assert 0.0 < on["overlap_efficiency_predicted"] <= 1.0
+    assert on["overlap_efficiency_measured"] == 0.8125
+
+
+# ------------------------------------------------- engine differentials
+
+
+def test_streamed_single_cohort_bit_identical():
+    """THE r16 fast gate: the streamed runner over one cohort window
+    (two launches, so the window re-enters kstep mid-residency) is
+    bit-identical to the XLA path on full State AND full Metrics over
+    the faulted universe."""
+    scfg = dataclasses.replace(FAULTED, **STREAMED)
+    st0 = state.init(FAULTED)
+    stx, mx = run(FAULTED, st0, 48, 0, metrics_init(64))
+    stp, mp = cohort.prun_streamed(scfg, st0, 48, interpret=True,
+                                   chunk_ticks=24)
+    ok, why = trees_equal_why(stx, stp)
+    assert ok, why
+    ok, why = trees_equal_why(mx, mp, names=list(type(mx)._fields))
+    assert ok, why
+
+
+@pytest.mark.slow
+def test_streamed_multi_cohort_three_way():
+    """THE r16 multi-cohort gate (slow tier: two extra interpret
+    traces): G spans three blocks, cohort_blocks=1 pages three windows,
+    chunk_ticks splits each residency into two launches — and the
+    streamed result is bit-identical to the resident kernel (State +
+    Metrics + flight ring) AND to the XLA path (State + Metrics)."""
+    from raft_tpu.obs import flight_init
+
+    g = 2_500   # pads to 3 x 1024-group blocks
+    cfg = dataclasses.replace(FAULTED, n_groups=g)
+    scfg = dataclasses.replace(cfg, **STREAMED)
+    assert len(cohort.cohort_windows(
+        scfg, [np.zeros((3 * pkernel.SUB, pkernel.LANE), np.int32)])) == 3
+    st0 = state.init(cfg)
+    stx, mx = run(cfg, st0, 24, 0, metrics_init(g))
+
+    leaves, gg = pkernel.kinit(cfg, st0, flight=flight_init(g))
+    leaves = pkernel.kstep(cfg, leaves, 0, 12, interpret=True)
+    leaves = pkernel.kstep(cfg, leaves, 12, 12, interpret=True)
+    stk, mk = pkernel.kfinish(cfg, leaves, gg)
+    flk = pkernel.kflight(cfg, leaves, gg)
+
+    stats = {}
+    sts, ms, fls = cohort.prun_streamed(
+        scfg, st0, 24, interpret=True, flight=flight_init(g),
+        chunk_ticks=12, stats=stats)
+    assert stats["cohorts"] == 3 and stats["launches"] == 6
+    assert 0.0 < stats["overlap_efficiency_measured"] <= 1.0
+    for ref_st, ref_m, what in ((stx, mx, "vs-xla"),
+                                (stk, mk, "vs-resident-kernel")):
+        ok, why = trees_equal_why(ref_st, sts)
+        assert ok, (what, why)
+        ok, why = trees_equal_why(ref_m, ms, names=list(type(ms)._fields))
+        assert ok, (what, why)
+    ok, why = trees_equal_why(flk, fls)
+    assert ok, ("flight-ring", why)
+
+
+def test_cohort_paging_is_identity_on_host_wire():
+    """Window slicing + writeback round-trips every byte: paging moves
+    state, never edits it — across an uneven tail window too."""
+    cfg = dataclasses.replace(FAULTED, **STREAMED)
+    host, g = cohort.host_wire(cfg, state.init(FAULTED))
+    before = [a.copy() for a in host]
+    for s0, s1 in cohort.cohort_windows(cfg, host):
+        cohort._writeback(host, cohort._window(host, s0, s1), s0, s1)
+    for i, (a, b) in enumerate(zip(before, host)):
+        assert np.array_equal(a, b), i
+
+
+def test_streaming_contracts_clean():
+    """The auditor's r16 pass holds on the clean tree (knob gating,
+    residency model, paging identity, cross-residency checkpoints)."""
+    from raft_tpu.analysis import contracts
+
+    assert contracts.streaming_problems() == []
+
+
+# ------------------------------------------------------------ checkpoint
+
+
+def test_checkpoint_residency_blind_both_directions():
+    """config.STREAM_FIELDS never block a resume: a file saved under
+    the streamed residency loads under the resident one and vice versa,
+    and a pre-r16 file (embedded cfg has no stream keys at all) loads
+    under a streamed cfg. Semantic mismatches still refuse."""
+    cfg_off = FAULTED
+    cfg_on = dataclasses.replace(FAULTED, **STREAMED)
+    st = state.init(cfg_off, n_groups=4)
+    met = metrics_init(4)
+    for save_cfg, load_cfg in ((cfg_off, cfg_on), (cfg_on, cfg_off)):
+        buf = io.BytesIO()
+        checkpoint.save(buf, st, 9, metrics=met, cfg=save_cfg)
+        buf.seek(0)
+        st2, t2, met2 = checkpoint.load(buf, cfg=load_cfg)
+        assert t2 == 9 and trees_equal(st, st2) and trees_equal(met, met2)
+    # Pre-r16 file: strip the stream keys from the embedded cfg dict.
+    buf = io.BytesIO()
+    checkpoint.save(buf, st, 9, metrics=met, cfg=cfg_off)
+    buf.seek(0)
+    with np.load(buf) as z:
+        data = {k: z[k] for k in z.files}
+    saved = json.loads(bytes(data["__cfg__"]).decode())
+    for k in STREAM_FIELDS:
+        assert k in saved   # the strip below must actually strip
+        saved.pop(k)
+    data["__cfg__"] = np.bytes_(json.dumps(saved, sort_keys=True))
+    buf = io.BytesIO()
+    np.savez(buf, **data)
+    buf.seek(0)
+    st2, t2, _ = checkpoint.load(buf, cfg=cfg_on)
+    assert t2 == 9 and trees_equal(st, st2)
+    # A SEMANTIC mismatch still refuses, residency knobs notwithstanding.
+    buf.seek(0)
+    with pytest.raises(ValueError, match="cfg mismatch"):
+        checkpoint.load(buf, cfg=dataclasses.replace(cfg_on, seed=99))
+
+
+# ------------------------------------------------------------- manifests
+
+
+def test_manifest_stream_keys_present_from_birth_and_backfilled():
+    """r16 satellite: every manifest record carries the stream keys
+    (null until stamped), history.backfill_record nulls them onto
+    pre-r16 records, and the auditor's manifest pass names a side that
+    forgot them — emit and backfill both."""
+    from raft_tpu.analysis import contracts
+    from raft_tpu.obs import history
+    from raft_tpu.obs.manifest import STREAM_KEYS, emit_manifest
+
+    assert tuple(STREAM_KEYS[:len(STREAM_FIELDS)]) == tuple(STREAM_FIELDS)
+    assert tuple(history.R16_MANIFEST_KEYS) == tuple(STREAM_KEYS)
+    rec = emit_manifest("probe", FAULTED, path="-")
+    for k in STREAM_KEYS:
+        assert k in rec and rec[k] is None
+    old = {k: v for k, v in rec.items() if k not in STREAM_KEYS}
+    back = history.backfill_record(old)
+    for k in STREAM_KEYS:
+        assert k in back and back[k] is None
+    assert contracts.manifest_problems() == []
+    # Drift detection both directions: an emit side that forgot the
+    # keys, and a backfill side that forgot them.
+
+    class _NoStreamManifest:
+
+        @staticmethod
+        def emit_manifest(segment, cfg, device=None, path=None, **fields):
+            rec = emit_manifest(segment, cfg, device=device, path="-",
+                                **fields)
+            return {k: v for k, v in rec.items() if k not in STREAM_KEYS}
+
+    probs = contracts.manifest_problems(manifest_mod=_NoStreamManifest)
+    assert any("stream_groups" in p for p in probs)
+
+    class _NoStreamHistory:
+
+        @staticmethod
+        def backfill_record(rec):
+            out = dict(rec)
+            for k in (history.R12_MANIFEST_KEYS + history.R13_MANIFEST_KEYS
+                      + history.R14_MANIFEST_KEYS):
+                out.setdefault(k, None)
+            return out   # forgot the r16 keys
+
+    probs = contracts.manifest_problems(history_mod=_NoStreamHistory)
+    assert any("stream_groups" in p for p in probs)
