@@ -216,16 +216,24 @@ def _nemesis_fields(cfg) -> dict:
     return vals
 
 
-def _stream_fields(cfg, measured=None) -> dict:
-    """The r16 manifest stamp: the residency knobs plus the predicted /
-    measured overlap efficiency of the cohort paging pipeline
-    (obs.manifest.STREAM_KEYS, null-by-default in every record until
-    stamped here; DESIGN.md §15). `measured` is the compute_s / wall_s
-    split from a streamed run's pipeline stats — None on resident
-    engines and off-TPU (predicted still derives whenever the segment's
-    cfg streams, so the model stays inspectable on CPU boxes)."""
-    return obs_roofline.stream_segment_fields(cfg, measured=measured,
-                                              chunk_ticks=CHUNK)
+def _stream_fields(cfg, pal=None) -> dict:
+    """The r16/r17 manifest stamp: the residency knobs plus the
+    predicted / measured overlap efficiency of the cohort paging
+    pipeline AND its per-device split (obs.manifest.STREAM_KEYS +
+    STREAM_MESH_KEYS, null-by-default in every record until stamped
+    here; DESIGN.md §15/§16). `pal` is the kernel-side segment dict:
+    its `overlap_measured` / `stream_per_device_measured` /
+    `stream_slowest_device` come from a streamed run's pipeline stats
+    — None on resident engines and off-TPU (predicted still derives
+    whenever the segment's cfg streams, so the model stays
+    inspectable on CPU boxes), and its `nd` is the device count the
+    streamed engine paged over (ignored on resident configs)."""
+    pal = pal or {}
+    return obs_roofline.stream_segment_fields(
+        cfg, measured=pal.get("overlap_measured"), chunk_ticks=CHUNK,
+        n_devices=(pal.get("nd") or 1) if cfg.stream_groups else 1,
+        per_device_measured=pal.get("stream_per_device_measured"),
+        slowest_device=pal.get("stream_slowest_device"))
 
 
 def _roofline_fields(cfg, n_groups: int, engine: str, ticks: int,
@@ -462,47 +470,63 @@ def _pallas_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
 
 def _streamed_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
                       st_ref, m_ref, f_ref, what: str):
-    """--stream twin of `_pallas_segment` (DESIGN.md §15): the cohort
-    scheduler pages the fleet host<->HBM under the unchanged kernel.
+    """--stream twin of `_pallas_segment` (DESIGN.md §15; §16): the
+    cohort scheduler pages the fleet host<->HBM under the unchanged
+    kernel — auto-sharded over every visible TPU chip (r17: each
+    device pages its own whole-block window slice concurrently,
+    engine `pallas-streamed-sharded-Ndev`), single-device otherwise.
     Same warmup/timing/promotion protocol — warmup advances the SAME
     universe by 2*CHUNK ticks (absorbing the window-shape compile), the
-    timed region is one `stream_ticks` pass over the remaining ticks,
-    and promotion requires the full State + full Metrics + flight ring
+    timed region is one stream pass over the remaining ticks, and
+    promotion requires the full State + full Metrics + flight ring
     bit-identical to the XLA reference at the same tick. Adds
-    `overlap_measured` (compute_s / wall_s from the pipeline stats) for
-    the STREAM_KEYS stamp; single-device by construction (the sharded
-    mesh path stays resident — host paging composes per chip, owed to
-    the driver's TPU pod column)."""
+    `overlap_measured` (compute_s / wall_s from the pipeline stats)
+    plus the per-device split (`stream_per_device_measured` /
+    `stream_slowest_device`) for the STREAM_KEYS + STREAM_MESH_KEYS
+    stamp."""
     from raft_tpu.parallel import cohort
+    mesh = _kernel_mesh()
+    nd = mesh.size if mesh is not None else 1
+    eng = cohort.sharded_engine(nd) if mesh is not None else cohort.ENGINE
     fail = dict(rate=None, count=None, elapsed=None, warmup_s=None,
                 state_identical=None, metrics_identical=None,
-                flight_identical=None, engine=cohort.ENGINE, nd=1,
-                overlap_measured=None)
+                flight_identical=None, engine=eng, nd=nd,
+                overlap_measured=None, stream_per_device_measured=None,
+                stream_slowest_device=None)
     try:   # kernel failure of ANY kind never kills the bench
         from raft_tpu.sim import pkernel
-        if not (pkernel.supported(cfg, n_groups, 1)
+        if not (pkernel.supported(cfg, n_groups, nd)
                 and jax.devices()[0].platform == "tpu"):
             return {**fail, "status": "unsupported"}
         counter_fn = functools.partial(getattr(pkernel, counter_name), cfg)
         host, g = cohort.host_wire(cfg, sim.init(cfg, n_groups=n_groups),
-                                   flight=flight_init(n_groups))
+                                   flight=flight_init(n_groups),
+                                   pad_to=nd * pkernel.GB)
+
+        def stream(h, t0s, n, stats=None):
+            if mesh is not None:
+                return cohort.stream_ticks_sharded(
+                    cfg, h, g, t0s, n, mesh, chunk_ticks=CHUNK,
+                    stats=stats)
+            return cohort.stream_ticks(cfg, h, g, t0s, n,
+                                       chunk_ticks=CHUNK, stats=stats)
+
         t0 = time.perf_counter()
         with obs_trace.span(f"warmup+compile streamed [{what}]"):
-            cohort.stream_ticks(cfg, host, g, 0, 2 * CHUNK,
-                                chunk_ticks=CHUNK)
+            stream(host, 0, 2 * CHUNK)
             base = counter_fn(host, g)
         warmup_s = time.perf_counter() - t0
-        log(f"  [streamed] warmup {2 * CHUNK} ticks (incl. compile): "
-            f"{warmup_s:.1f}s")
+        log(f"  [streamed{'' if nd == 1 else f' x{nd}dev'}] warmup "
+            f"{2 * CHUNK} ticks (incl. compile): {warmup_s:.1f}s")
         stats: dict = {}
         start = time.perf_counter()
         with obs_trace.span(f"timed streamed [{what}]"):
-            cohort.stream_ticks(cfg, host, g, 2 * CHUNK, timed_ticks,
-                                chunk_ticks=CHUNK, stats=stats)
+            stream(host, 2 * CHUNK, timed_ticks, stats=stats)
             count = counter_fn(host, g) - base   # fetch closes the timer
         elapsed = time.perf_counter() - start
         rate = count / elapsed
-        log(f"  [streamed] {n_groups} groups x {timed_ticks} ticks "
+        log(f"  [streamed{'' if nd == 1 else f' x{nd}dev'}] "
+            f"{n_groups} groups x {timed_ticks} ticks "
             f"({stats['cohorts']} cohort windows, {stats['launches']} "
             f"launches): {count} {what} in {elapsed:.2f}s -> "
             f"{rate:,.0f} {what}/s (measured overlap "
@@ -524,9 +548,13 @@ def _streamed_segment(cfg, n_groups: int, timed_ticks: int, counter_name,
                 "+ full Metrics + flight ring bit-identical")
             return dict(rate=rate, count=count, elapsed=elapsed,
                         warmup_s=warmup_s, status="ok",
-                        engine=cohort.ENGINE, nd=1,
+                        engine=eng, nd=nd,
                         overlap_measured=stats.get(
-                            "overlap_efficiency_measured"), **verdicts)
+                            "overlap_efficiency_measured"),
+                        stream_per_device_measured=stats.get(
+                            "overlap_efficiency_per_device_measured"),
+                        stream_slowest_device=stats.get("slowest_device"),
+                        **verdicts)
         log(f"  [streamed] DIFFERENTIAL MISMATCH (state_identical="
             f"{state_ok} metrics_identical={metrics_ok} flight_identical="
             f"{flight_ok}) - streamed number discarded")
@@ -628,44 +656,63 @@ def _pallas_full_run(cfg, n_groups: int, ticks: int, counter_name: str,
 
 def _streamed_full_run(cfg, n_groups: int, ticks: int, counter_name: str,
                        label: str, st_ref, m_ref, f_ref):
-    """--stream twin of `_pallas_full_run` (DESIGN.md §15): the
-    from-tick-0 histogram segments under the cohort scheduler. Same
-    protocol — throwaway-universe warmup absorbs the window-shape
-    compile, the timed region streams the real universe from tick 0,
-    promotion requires the full State + full Metrics + flight ring
-    bit-identical against the XLA reference. Fills `overlap_measured`
-    from the pipeline stats for the STREAM_KEYS stamp."""
+    """--stream twin of `_pallas_full_run` (DESIGN.md §15; §16): the
+    from-tick-0 histogram segments under the cohort scheduler —
+    auto-sharded over every visible TPU chip (r17), single-device
+    otherwise. Same protocol — throwaway-universe warmup absorbs the
+    window-shape compile, the timed region streams the real universe
+    from tick 0, promotion requires the full State + full Metrics +
+    flight ring bit-identical against the XLA reference. Fills
+    `overlap_measured` plus the per-device split from the pipeline
+    stats for the STREAM_KEYS + STREAM_MESH_KEYS stamp."""
     from raft_tpu.parallel import cohort
+    mesh = _kernel_mesh()
+    nd = mesh.size if mesh is not None else 1
+    eng = cohort.sharded_engine(nd) if mesh is not None else cohort.ENGINE
     out = dict(engine="xla-scan", promoted=False, k_elapsed=None,
                k_warmup_s=None, state_ok=None, metrics_ok=None,
-               flight_ok=None, nd=1, k_name=cohort.ENGINE,
-               overlap_measured=None)
+               flight_ok=None, nd=nd, k_name=eng,
+               overlap_measured=None, stream_per_device_measured=None,
+               stream_slowest_device=None)
     try:
         from raft_tpu.sim import pkernel
-        if not (pkernel.supported(cfg, n_groups, 1)
+        if not (pkernel.supported(cfg, n_groups, nd)
                 and jax.devices()[0].platform == "tpu"):
             return out
         counter = functools.partial(getattr(pkernel, counter_name), cfg)
+
+        def stream(h, hg, t0s, n, stats=None):
+            if mesh is not None:
+                return cohort.stream_ticks_sharded(
+                    cfg, h, hg, t0s, n, mesh, chunk_ticks=CHUNK,
+                    stats=stats)
+            return cohort.stream_ticks(cfg, h, hg, t0s, n,
+                                       chunk_ticks=CHUNK, stats=stats)
+
         t0 = time.perf_counter()
         with obs_trace.span(f"warmup+compile streamed [{label}]"):
             wh, wg = cohort.host_wire(cfg,
                                       sim.init(cfg, n_groups=n_groups),
-                                      flight=flight_init(n_groups))
-            cohort.stream_ticks(cfg, wh, wg, 0, CHUNK, chunk_ticks=CHUNK)
+                                      flight=flight_init(n_groups),
+                                      pad_to=nd * pkernel.GB)
+            stream(wh, wg, 0, CHUNK)
             counter(wh, wg)
         out["k_warmup_s"] = time.perf_counter() - t0
-        log(f"  [streamed] warmup (incl. compile): "
-            f"{out['k_warmup_s']:.1f}s")
+        log(f"  [streamed{'' if nd == 1 else f' x{nd}dev'}] warmup "
+            f"(incl. compile): {out['k_warmup_s']:.1f}s")
         host, g = cohort.host_wire(cfg, sim.init(cfg, n_groups=n_groups),
-                                   flight=flight_init(n_groups))
+                                   flight=flight_init(n_groups),
+                                   pad_to=nd * pkernel.GB)
         stats: dict = {}
         start = time.perf_counter()
         with obs_trace.span(f"timed streamed [{label}]"):
-            cohort.stream_ticks(cfg, host, g, 0, ticks, chunk_ticks=CHUNK,
-                                stats=stats)
+            stream(host, g, 0, ticks, stats=stats)
             counter(host, g)   # fetch closes the timer
         out["k_elapsed"] = time.perf_counter() - start
         out["overlap_measured"] = stats.get("overlap_efficiency_measured")
+        out["stream_per_device_measured"] = stats.get(
+            "overlap_efficiency_per_device_measured")
+        out["stream_slowest_device"] = stats.get("slowest_device")
         leaves = tuple(host)
         st_s, m_s = pkernel.kfinish(cfg, leaves, g)
         f_s = pkernel.kflight(cfg, leaves, g)
@@ -682,7 +729,7 @@ def _streamed_full_run(cfg, n_groups: int, ticks: int, counter_name: str,
         if state_ok and metrics_ok and flight_ok:
             log("  [streamed] differential vs xla at same tick: full "
                 "State + full Metrics + flight ring bit-identical")
-            out.update(engine=cohort.ENGINE, promoted=True)
+            out.update(engine=eng, promoted=True)
         else:
             log(f"  [streamed] DIFFERENTIAL MISMATCH (state_identical="
                 f"{state_ok} metrics_identical={metrics_ok} "
@@ -746,7 +793,7 @@ def bench_throughput(n_groups: int, ticks: int):
         **_roofline_fields(cfg, n_groups, engine, timed_ticks, elapsed,
                            nd=pal["nd"] if engine == pal["engine"] else 1),
         **_packing_fields(cfg),
-        **_stream_fields(cfg, pal.get("overlap_measured")),
+        **_stream_fields(cfg, pal),
     }
     emit_manifest("throughput", cfg, device=_device_str(),
                   n_groups=n_groups, **seg)
@@ -839,7 +886,7 @@ def bench_fault_latency(seed: int, n_groups: int, ticks: int, label: str):
         **_roofline_fields(cfg, n_groups, engine, ticks, elapsed,
                            nd=nd if engine == k_name else 1),
         **_packing_fields(cfg),
-        **_stream_fields(cfg, pal.get("overlap_measured")),
+        **_stream_fields(cfg, pal),
     }
     emit_manifest(label, cfg, device=_device_str(),
                   **{k: v for k, v in seg.items() if k != "p99_note"})
@@ -929,7 +976,7 @@ def bench_nemesis(seed: int, n_groups: int, ticks: int, label: str):
         **_roofline_fields(cfg, n_groups, engine, ticks, elapsed,
                            nd=nd if engine == k_name else 1),
         **_packing_fields(cfg),
-        **_stream_fields(cfg, pal.get("overlap_measured")),
+        **_stream_fields(cfg, pal),
     }
     emit_manifest(label, cfg, device=_device_str(), **seg)
     return seg
@@ -983,7 +1030,7 @@ def bench_election_rounds(n_groups: int, ticks: int):
         **_roofline_fields(cfg, n_groups, engine, timed_ticks, elapsed,
                            nd=pal["nd"] if engine == pal["engine"] else 1),
         **_packing_fields(cfg),
-        **_stream_fields(cfg, pal.get("overlap_measured")),
+        **_stream_fields(cfg, pal),
     }
     emit_manifest("election-rounds", cfg, device=_device_str(),
                   n_groups=n_groups, ticks=timed_ticks, **seg)
@@ -1029,7 +1076,7 @@ def bench_reads(n_groups: int, ticks: int):
         **_roofline_fields(cfg, n_groups, engine, timed_ticks, elapsed,
                            nd=pal["nd"] if engine == pal["engine"] else 1),
         **_packing_fields(cfg),
-        **_stream_fields(cfg, pal.get("overlap_measured")),
+        **_stream_fields(cfg, pal),
     }
     emit_manifest("reads", cfg, device=_device_str(), n_groups=n_groups,
                   ticks=timed_ticks, **seg)
@@ -1133,7 +1180,7 @@ def bench_clients(seed: int, n_groups: int, ticks: int, label: str):
         **_roofline_fields(cfg, n_groups, engine, ticks, elapsed,
                            nd=nd if engine == k_name else 1),
         **_packing_fields(cfg),
-        **_stream_fields(cfg, pal.get("overlap_measured")),
+        **_stream_fields(cfg, pal),
     }
     emit_manifest(label, cfg, device=_device_str(), **seg)
     return seg

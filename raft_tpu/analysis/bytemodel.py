@@ -342,6 +342,45 @@ def derived_wire_model(cfg: RaftConfig, with_flight: bool = True) -> dict:
             f"{pkernel.HOST_RAM_LIMIT_BYTES} B host RAM) — the streamed "
             f"residency model drifted from the derived byte model")
 
+    # r17 sharded streaming (DESIGN.md §16): with each of N devices
+    # paging its own window slice off its own host-RAM allocation, the
+    # ceiling is N x the per-device host bound — re-derive it from THIS
+    # module's wire bytes (N x expect_streamed, the multi-host/pod
+    # allocation model supported() budgets), pin the exact boundary at
+    # 8 devices, and hold the ISSUE r17 acceptance floor: >= 4x the
+    # 1-device streamed ceiling at 8 devices.
+    ND_SHARDED = 8
+    sharded_ceiling = pkernel.streamed_ceiling_groups(
+        scfg, n_devices=ND_SHARDED, with_flight=with_flight)
+    window_hbm_sharded = pkernel.cohort_hbm_bytes(
+        scfg, with_flight=with_flight, n_devices=ND_SHARDED)
+    sharded_ok = (
+        window_hbm_sharded <= pkernel.HBM_LIMIT_BYTES
+        and pkernel.supported(scfg, n_groups=sharded_ceiling,
+                              n_devices=ND_SHARDED,
+                              with_flight=with_flight)
+        and not pkernel.supported(scfg,
+                                  n_groups=sharded_ceiling + pkernel.GB,
+                                  n_devices=ND_SHARDED,
+                                  with_flight=with_flight))
+    if not sharded_ok:
+        problems.append(
+            f"sharded streamed ceiling {sharded_ceiling} at {ND_SHARDED} "
+            f"devices is not the exact supported() boundary "
+            f"(with_flight={with_flight})")
+    if sharded_ceiling != ND_SHARDED * expect_streamed:
+        problems.append(
+            f"sharded streamed ceiling {sharded_ceiling} != "
+            f"{ND_SHARDED} x {expect_streamed} implied by the derived "
+            f"wire bytes over {ND_SHARDED} per-device host-RAM "
+            f"allocations — the sharded residency model drifted from "
+            f"the derived byte model")
+    if streamed_ceiling and sharded_ceiling < 4 * streamed_ceiling:
+        problems.append(
+            f"sharded streamed ceiling {sharded_ceiling} at {ND_SHARDED} "
+            f"devices is under 4x the 1-device ceiling "
+            f"{streamed_ceiling} — the r17 scaling floor")
+
     return {
         "config": {"k": cfg.k, "log_cap": cfg.log_cap,
                    "max_entries_per_msg": cfg.max_entries_per_msg,
@@ -381,6 +420,21 @@ def derived_wire_model(cfg: RaftConfig, with_flight: bool = True) -> dict:
                     "cohort_blocks": scfg.cohort_blocks,
                     "stream_windows": pkernel._stream_windows(scfg),
                     "window_hbm_bytes": window_hbm,
+                    # r17: the device axis — per-device host-RAM
+                    # allocations (multi-host/pod model), whole-block
+                    # per-device window slices.
+                    "sharded": {
+                        "n_devices": ND_SHARDED,
+                        "ceiling_groups": sharded_ceiling,
+                        "boundary_exact": bool(sharded_ok),
+                        "speedup_vs_1dev": (
+                            round(sharded_ceiling / streamed_ceiling, 2)
+                            if streamed_ceiling else None),
+                        "blocks_per_device":
+                            pkernel.stream_blocks_per_device(
+                                scfg, ND_SHARDED),
+                        "window_hbm_bytes_per_device": window_hbm_sharded,
+                    },
                 }},
         "problems": problems,
     }

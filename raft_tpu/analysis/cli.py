@@ -54,6 +54,33 @@ def main(argv=None) -> int:
         if not problems:
             print("SELF-TEST FAILED: injected drift went undetected")
             return 2
+        # Second synthetic-drift leg (r17): a history module whose
+        # backfill drops the streamed-mesh keys must be caught by the
+        # manifest pass — proves the STREAM_MESH_KEYS coverage check
+        # end-to-end, not just the PerNode registry one.
+        import types
+
+        from raft_tpu.obs import history as _hist
+        from raft_tpu.obs import manifest as _man
+
+        def _drifted_backfill(rec):
+            out = _hist.backfill_record(rec)
+            for k in _man.STREAM_MESH_KEYS:
+                out.pop(k, None)
+            return out
+
+        stub = types.SimpleNamespace(**{
+            **{k: getattr(_hist, k) for k in dir(_hist)
+               if not k.startswith("_")},
+            "backfill_record": _drifted_backfill})
+        mesh_problems = [p for p in contracts.manifest_problems(
+            history_mod=stub) if "stream_slowest_device" in p]
+        for p in mesh_problems:
+            print(f"CONTRACT DRIFT (synthetic r17): {p}")
+        if not mesh_problems:
+            print("SELF-TEST FAILED: dropped STREAM_MESH_KEYS backfill "
+                  "went undetected by manifest_problems")
+            return 2
         return 1
 
     report = analysis.audit_report(level=args.level)

@@ -966,6 +966,29 @@ def streaming_problems(include_behavioral: bool = True) -> list[str]:
             problems.append(
                 f"[{label}] streamed_ceiling_groups {ceiling} is not the "
                 f"exact supported() boundary under stream_groups")
+        # r17 mesh axis: at every device count the PER-DEVICE window
+        # slice fits HBM and the sharded-streamed ceiling stays the
+        # exact supported() boundary (one more block over-promises).
+        for nd in (2, 8):
+            if pkernel.cohort_hbm_bytes(cfg, True, nd) \
+                    > pkernel.HBM_LIMIT_BYTES:
+                problems.append(
+                    f"[{label}] per-device cohort window at {nd} devices "
+                    f"({pkernel.cohort_hbm_bytes(cfg, True, nd)} B) does "
+                    f"not fit the {pkernel.HBM_LIMIT_BYTES} B HBM budget")
+                continue
+            nceil = pkernel.streamed_ceiling_groups(cfg, n_devices=nd)
+            if not (pkernel.supported(cfg, n_groups=nceil, n_devices=nd)
+                    and not pkernel.supported(
+                        cfg, n_groups=nceil + pkernel.GB, n_devices=nd)):
+                problems.append(
+                    f"[{label}] sharded streamed_ceiling_groups {nceil} at "
+                    f"{nd} devices is not the exact supported() boundary")
+            if nceil < pkernel.streamed_ceiling_groups(cfg):
+                problems.append(
+                    f"[{label}] sharded streamed ceiling at {nd} devices "
+                    f"({nceil}) fell below the 1-device ceiling — adding "
+                    f"devices must never shrink the admitted fleet")
 
     if not include_behavioral:
         return problems
@@ -988,6 +1011,45 @@ def streaming_problems(include_behavioral: bool = True) -> list[str]:
             problems.append(
                 f"cohort paging round trip changed wire leaf #{i} — "
                 f"window slicing/writeback must be the identity")
+    # r17 sharded paging: on a mesh (2 devices when the box has them,
+    # else the 1-device degenerate mesh — the code path is identical),
+    # every per-device window slice is whole 1024-group blocks, every
+    # paged-in leaf carries the r08 kleaf_spec sharding, and the staged
+    # put/drain round trip is the identity on the host wire.
+    from raft_tpu.parallel import stream_sched
+    from raft_tpu.parallel.kmesh import kleaf_spec
+    from raft_tpu.parallel.mesh import make_mesh
+    nd = 2 if len(jax.local_devices()) >= 2 else 1
+    mesh = make_mesh(nd, allow_cpu_fallback=True)
+    cfg = _streamed_cfgs()["streamed-1blk"]
+    host_leaves, g = cohort.host_wire(cfg, sim.init(cfg, n_groups=2),
+                                      pad_to=nd * pkernel.GB)
+    before = [a.copy() for a in host_leaves]
+    pool = stream_sched.StagingPool(
+        host_leaves, pkernel.stream_blocks_per_device(cfg, nd) * nd
+        * pkernel.SUB)
+    for i, (s0, s1) in enumerate(
+            cohort.cohort_windows(cfg, host_leaves, n_devices=nd)):
+        for dev, (lo, hi) in stream_sched.device_slices(
+                mesh, host_leaves[0], s0, s1):
+            if (hi - lo) % pkernel.SUB:
+                problems.append(
+                    f"sharded window [{s0},{s1}) slice on {dev} covers "
+                    f"sublanes [{lo},{hi}) — not whole 1024-group blocks")
+        window = stream_sched.put_window(host_leaves, s0, s1, mesh,
+                                         pool=pool, slot=i)
+        for j, leaf in enumerate(window):
+            if leaf.sharding.spec != kleaf_spec(leaf):
+                problems.append(
+                    f"sharded window leaf #{j} paged in under "
+                    f"{leaf.sharding.spec}, not the r08 kleaf_spec "
+                    f"{kleaf_spec(leaf)} — kstep_sharded would reshard")
+        stream_sched.drain_window(host_leaves, window, s0, s1)
+    for i, (a, b) in enumerate(zip(before, host_leaves)):
+        if not np.array_equal(a, b):
+            problems.append(
+                f"sharded cohort paging round trip changed wire leaf "
+                f"#{i} — per-device slicing/drain must be the identity")
     # A checkpoint saved under one residency loads under the other (and
     # a pre-r16 file — no stream keys at all — loads under a streamed
     # cfg: the same backfill rule, exercised via the defaults table).
@@ -1029,7 +1091,15 @@ def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
     hist = real_history if history_mod is None else history_mod
     problems = []
     keys = (real_manifest.ROOFLINE_KEYS + real_manifest.PACKING_KEYS
-            + real_manifest.NEMESIS_KEYS + real_manifest.STREAM_KEYS)
+            + real_manifest.NEMESIS_KEYS + real_manifest.STREAM_KEYS
+            + real_manifest.STREAM_MESH_KEYS)
+    if tuple(real_history.R17_MANIFEST_KEYS) \
+            != tuple(real_manifest.STREAM_MESH_KEYS):
+        problems.append(
+            f"obs.history.R17_MANIFEST_KEYS {real_history.R17_MANIFEST_KEYS}"
+            f" != obs.manifest.STREAM_MESH_KEYS "
+            f"{real_manifest.STREAM_MESH_KEYS} — the emit-side and "
+            f"backfill-side key lists drifted")
     if tuple(real_history.R16_MANIFEST_KEYS) \
             != tuple(real_manifest.STREAM_KEYS):
         problems.append(
@@ -1087,12 +1157,16 @@ def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
                              predicted_rounds_per_sec=1.0,
                              pack_bools=True, wire_hist=False,
                              stream_groups=True, cohort_blocks=2,
-                             overlap_efficiency_predicted=0.75)
+                             overlap_efficiency_predicted=0.75,
+                             stream_devices=8, stream_blocks_per_device=1,
+                             stream_slowest_device=3)
     for k, want in (("bound", "hbm"), ("attainment_pct", 12.5),
                     ("predicted_rounds_per_sec", 1.0),
                     ("pack_bools", True), ("wire_hist", False),
                     ("stream_groups", True), ("cohort_blocks", 2),
-                    ("overlap_efficiency_predicted", 0.75)):
+                    ("overlap_efficiency_predicted", 0.75),
+                    ("stream_devices", 8), ("stream_blocks_per_device", 1),
+                    ("stream_slowest_device", 3)):
         if rec2.get(k) != want:
             problems.append(f"manifest dropped the caller's {k!r} value "
                             f"({rec2.get(k)!r} != {want!r})")

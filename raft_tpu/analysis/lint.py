@@ -44,10 +44,13 @@ from typing import Iterable
 # branch only on shapes/knobs, never on traced lane VALUES — a
 # value-dependent paging decision would make the streamed engine's
 # schedule diverge from the resident kernel it must stay bit-identical
-# to.
+# to. r17 adds the shard-aware scheduler (parallel/stream_sched.py):
+# per-device slicing and staging decisions are schedule, so the same
+# shapes-and-knobs-only rule applies.
 DEFAULT_TARGETS = ("sim/step.py", "sim/pkernel.py", "clients/workload.py",
                    "utils/jrng.py", "nemesis/program.py",
-                   "nemesis/search.py", "parallel/cohort.py")
+                   "nemesis/search.py", "parallel/cohort.py",
+                   "parallel/stream_sched.py")
 
 # The jrng functions the elementwise rule covers (the compiled nemesis
 # evaluators — DESIGN.md §14; the rest of jrng predates the rule and is

@@ -80,6 +80,20 @@ R16_MANIFEST_KEYS = ("stream_groups", "cohort_blocks",
                      "overlap_efficiency_predicted",
                      "overlap_efficiency_measured")
 
+# Manifest keys added by the r17 sharded-streaming layer (the device
+# axis of the cohort pipeline: device count, per-device window blocks,
+# per-device predicted/measured overlap split, slowest device —
+# DESIGN.md §16) — same present-from-birth / backfilled-as-null
+# contract. Its own literal (the registry idiom), proven equal to
+# obs.manifest.STREAM_MESH_KEYS by the auditor. The engine strings
+# these records carry ("pallas-streamed-sharded-Ndev") classify as
+# "pallas" via `engine_class`'s prefix rule, so the regression gate
+# files them with the other kernel-residency series.
+R17_MANIFEST_KEYS = ("stream_devices", "stream_blocks_per_device",
+                     "overlap_efficiency_per_device_predicted",
+                     "overlap_efficiency_per_device_measured",
+                     "stream_slowest_device")
+
 # Manifest records below this group count are smoke/--quick shapes:
 # correctness drives, not trajectory points — a 1K-group quick run's
 # rate joining the 100K series would trip (or mask) the regression
@@ -131,12 +145,12 @@ def _round_of(path: str) -> int | None:
 def backfill_record(rec: dict) -> dict:
     """A manifest record normalized to the current schema: the r12
     roofline/trace keys, the r13 wire-layout keys, the r14 nemesis
-    keys, AND the r16 streaming keys present-but-null when the record
-    predates them (same rule as the mesh keys at r08). Returns a new
-    dict."""
+    keys, the r16 streaming keys, AND the r17 sharded-streaming keys
+    present-but-null when the record predates them (same rule as the
+    mesh keys at r08). Returns a new dict."""
     out = dict(rec)
     for k in (R12_MANIFEST_KEYS + R13_MANIFEST_KEYS + R14_MANIFEST_KEYS
-              + R16_MANIFEST_KEYS):
+              + R16_MANIFEST_KEYS + R17_MANIFEST_KEYS):
         out.setdefault(k, None)
     return out
 

@@ -90,6 +90,19 @@ STREAM_KEYS = ("stream_groups", "cohort_blocks",
                "overlap_efficiency_predicted",
                "overlap_efficiency_measured")
 
+# r17 sharded-streaming keys: the device axis of the cohort pipeline
+# (DESIGN.md §16) — how many devices paged concurrently, the whole-
+# block per-device window share, and the per-device predicted/measured
+# overlap split (slowest device owns every window wall; the measured
+# list and `stream_slowest_device` name it). Present-but-null from
+# birth, backfilled on read, proven both directions by the auditor's
+# manifest pass — the same lifecycle as every registry above.
+# Producer: obs.roofline.stream_segment_fields.
+STREAM_MESH_KEYS = ("stream_devices", "stream_blocks_per_device",
+                    "overlap_efficiency_per_device_predicted",
+                    "overlap_efficiency_per_device_measured",
+                    "stream_slowest_device")
+
 
 def config_hash(cfg) -> str:
     """Stable short hash of the SEMANTIC config — two runs with equal
@@ -141,7 +154,7 @@ def emit_manifest(segment: str, cfg, device: str | None = None,
            # roofline/trace keys follow the same rule.
            "mesh_shape": None, "groups_per_device": None,
            **{k: None for k in ROOFLINE_KEYS + PACKING_KEYS
-              + NEMESIS_KEYS + STREAM_KEYS}}
+              + NEMESIS_KEYS + STREAM_KEYS + STREAM_MESH_KEYS}}
     rec.update(fields)
     path = path or os.environ.get(MANIFEST_ENV) or DEFAULT_PATH
     if path != "-":

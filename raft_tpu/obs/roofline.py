@@ -306,10 +306,12 @@ def segment_fields(cfg, n_groups: int, engine: str | None,
 def overlap_efficiency(cfg, chunk_ticks: int | None = None,
                        ticks_per_cohort: int | None = None,
                        with_flight: bool = True,
-                       flops: bool = False) -> dict:
+                       flops: bool = False,
+                       n_devices: int = 1) -> dict:
     """Predicted overlap efficiency of the r16 cohort pipeline
-    (DESIGN.md §15): the fraction of steady-state pipeline time the
-    kernel (not the host link) owns the critical path,
+    (DESIGN.md §15; §16 for the sharded axis): the fraction of
+    steady-state pipeline time the kernel (not the host link) owns the
+    critical path,
 
         efficiency = t_compute / max(t_compute, t_copy)
 
@@ -324,28 +326,46 @@ def overlap_efficiency(cfg, chunk_ticks: int | None = None,
     soak that keeps each window resident for many launches amortizes
     the copies linearly (the derivation the returned dict spells out).
     1.0 == copies fully hidden; parallel/cohort.py's `stats` measures
-    the real twin (`overlap_efficiency_measured`)."""
+    the real twin (`overlap_efficiency_measured`).
+
+    At `n_devices > 1` (the r17 sharded pipeline) every quantity is
+    PER DEVICE: each device pages and computes its own
+    `stream_blocks_per_device` slice of the window over its own
+    host link, so both t_copy and t_compute shrink N-fold and the
+    efficiency — a ratio — is unchanged for divisible windows. The
+    model is symmetric (identical devices), so the per-device
+    predicted split is N equal entries; the pipeline's window wall is
+    the SLOWEST device's wall, which is what the measured split in
+    `cohort.stream_ticks_sharded`'s stats exists to catch deviating."""
     from raft_tpu.sim import pkernel
 
     chunk = chunk_ticks or DEFAULT_CHUNK_TICKS
     resident_ticks = ticks_per_cohort or chunk
-    window_groups = cfg.cohort_blocks * pkernel.GB
+    bpd = pkernel.stream_blocks_per_device(cfg, n_devices)
+    window_groups = bpd * n_devices * pkernel.GB
+    dev_groups = bpd * pkernel.GB
     model = _derived_model(cfg, with_flight)
     wire = model["wire_bytes_derived"]
-    window_bytes = wire * window_groups
+    window_bytes = wire * dev_groups
     copy_s = 2.0 * window_bytes / (peak_host_gbps() * 1e9)
-    # Per-tick kernel time at the window shape (§12 byte model: the
-    # wire crosses HBM once in and once out per chunk-tick launch).
+    # Per-tick kernel time at the per-device window shape (§12 byte
+    # model: the wire crosses HBM once in and once out per chunk-tick
+    # launch).
     hbm_s = (2.0 * window_bytes / chunk) / (peak_hbm_gbps() * 1e9)
-    fm = tick_flops(cfg, window_groups) if flops else None
+    fm = tick_flops(cfg, dev_groups) if flops else None
     vpu_s = (fm["flops_per_tick"] / (peak_vpu_gflops() * 1e9)
              if fm else 0.0)
     compute_s = resident_ticks * max(hbm_s, vpu_s)
     eff = compute_s / max(compute_s, copy_s) if copy_s > 0 else 1.0
     return {
         "overlap_efficiency_predicted": eff,
+        "overlap_efficiency_per_device_predicted":
+            [round(eff, 6)] * n_devices,
+        "n_devices": n_devices,
+        "blocks_per_device": bpd,
         "window_groups": window_groups,
-        "window_wire_bytes": window_bytes,
+        "window_groups_per_device": dev_groups,
+        "window_wire_bytes_per_device": window_bytes,
         "copy_s_per_window": copy_s,
         "compute_s_per_window": compute_s,
         "ticks_per_cohort": resident_ticks,
@@ -360,30 +380,53 @@ def stream_segment_fields(cfg, measured: float | None = None,
                           chunk_ticks: int | None = None,
                           ticks_per_cohort: int | None = None,
                           with_flight: bool = True,
-                          flops: bool = False) -> dict:
+                          flops: bool = False,
+                          n_devices: int = 1,
+                          per_device_measured: list | None = None,
+                          slowest_device=None) -> dict:
     """The r16 manifest stamp every segment carries
-    (obs.manifest.STREAM_KEYS, null-by-default in every record until
-    stamped here): the residency knobs the segment's kernel engine ran
-    with, the predicted overlap efficiency (meaningful — and computed —
-    only under cfg.stream_groups), and the measured value when the
-    cohort runner's `stats` produced one (null on CPU boxes /
-    non-streamed engines, same rule as attainment_pct). Derived against
-    the key registry so a manifest-side rename cannot drift past this
+    (obs.manifest.STREAM_KEYS + r17's STREAM_MESH_KEYS, null-by-default
+    in every record until stamped here): the residency knobs the
+    segment's kernel engine ran with, the predicted overlap efficiency
+    (meaningful — and computed — only under cfg.stream_groups) with its
+    per-device split, and the measured values when the cohort runner's
+    `stats` produced them (null on CPU boxes / non-streamed engines,
+    same rule as attainment_pct). `per_device_measured` /
+    `slowest_device` come straight from `stream_ticks_sharded`'s stats
+    (the slowest device owns every window wall). Derived against the
+    key registry so a manifest-side rename cannot drift past this
     producer."""
     from raft_tpu.config import STREAM_FIELDS
-    from raft_tpu.obs.manifest import STREAM_KEYS
+    from raft_tpu.obs.manifest import STREAM_KEYS, STREAM_MESH_KEYS
+    from raft_tpu.sim import pkernel
 
     vals = {k: getattr(cfg, k) for k in STREAM_FIELDS}
     pred = None
+    per_dev_pred = None
     if cfg.stream_groups:
-        pred = round(overlap_efficiency(
+        ov = overlap_efficiency(
             cfg, chunk_ticks=chunk_ticks, ticks_per_cohort=ticks_per_cohort,
-            with_flight=with_flight,
-            flops=flops)["overlap_efficiency_predicted"], 6)
+            with_flight=with_flight, flops=flops, n_devices=n_devices)
+        pred = round(ov["overlap_efficiency_predicted"], 6)
+        per_dev_pred = ov["overlap_efficiency_per_device_predicted"]
     vals["overlap_efficiency_predicted"] = pred
     vals["overlap_efficiency_measured"] = (round(measured, 6)
                                            if measured is not None else None)
-    if set(vals) != set(STREAM_KEYS):
-        raise RuntimeError(f"obs.manifest.STREAM_KEYS {STREAM_KEYS} drifted "
-                           f"from the roofline producer {set(vals)}")
+    # The mesh keys are null on resident engines (same rule as the
+    # overlap efficiencies): stream_devices answers "how many devices
+    # PAGED", which a resident segment must not claim.
+    vals["stream_devices"] = n_devices if cfg.stream_groups else None
+    vals["stream_blocks_per_device"] = (
+        pkernel.stream_blocks_per_device(cfg, n_devices)
+        if cfg.stream_groups else None)
+    vals["overlap_efficiency_per_device_predicted"] = per_dev_pred
+    vals["overlap_efficiency_per_device_measured"] = (
+        list(per_device_measured) if per_device_measured is not None
+        else None)
+    vals["stream_slowest_device"] = slowest_device
+    if set(vals) != set(STREAM_KEYS) | set(STREAM_MESH_KEYS):
+        raise RuntimeError(
+            f"obs.manifest STREAM_KEYS+STREAM_MESH_KEYS "
+            f"{set(STREAM_KEYS) | set(STREAM_MESH_KEYS)} drifted from "
+            f"the roofline producer {set(vals)}")
     return vals

@@ -1,10 +1,11 @@
 """Device-mesh sharding of the groups axis (DESIGN.md §5, config 5;
 §9 for the kernel wire form — raft_tpu.parallel.kmesh; §15 for the
-host<->HBM cohort paging path — raft_tpu.parallel.cohort)."""
+host<->HBM cohort paging path — raft_tpu.parallel.cohort; §16 for the
+two composed — raft_tpu.parallel.stream_sched + prun_streamed_sharded)."""
 
-from raft_tpu.parallel.cohort import prun_streamed
+from raft_tpu.parallel.cohort import prun_streamed, prun_streamed_sharded
 from raft_tpu.parallel.mesh import (AXIS, make_mesh, run_sharded,
                                     shard_state, state_sharding)
 
-__all__ = ["AXIS", "make_mesh", "prun_streamed", "run_sharded",
-           "shard_state", "state_sharding"]
+__all__ = ["AXIS", "make_mesh", "prun_streamed", "prun_streamed_sharded",
+           "run_sharded", "shard_state", "state_sharding"]

@@ -38,6 +38,17 @@ tests/test_streaming.py and the multichip sweep's three-way gate.
 Gated behind `cfg.stream_groups` / `cfg.cohort_blocks`
 (config.STREAM_FIELDS — residency-class knobs, default off, excluded
 from the checkpoint semantic match like LAYOUT_FIELDS).
+
+r17 composes this pipeline with the r08 device mesh (DESIGN.md §16):
+`prun_streamed_sharded` keeps the ONE writable host wire but splits
+every window into whole per-device block slices (`stream_sched` owns
+the copy path — staged per-device `device_put`s committed as one
+sharded array), launches `kmesh.kstep_sharded` instead of
+`pkernel.kstep`, and drains per-shard — all N devices page, compute,
+and drain concurrently, so the modeled ceiling becomes
+`pkernel.streamed_ceiling_groups(cfg, n_devices)` = N x the per-device
+host-RAM bound, and copy bandwidth scales with the N independent
+host<->device links.
 """
 
 from __future__ import annotations
@@ -79,27 +90,43 @@ def _on_host():
 
 
 def host_wire(cfg: RaftConfig, st: State, metrics: Metrics | None = None,
-              flight: Flight | None = None):
+              flight: Flight | None = None, pad_to: int | None = None):
     """(host_leaves, g): the fleet's full wire form as HOST numpy
     arrays — `pkernel.kinit` run on the host backend, each leaf pulled
     out of jax. This is the pinned store the pipeline pages from; it is
-    mutated in place by `stream_ticks`."""
+    mutated in place by `stream_ticks`. `pad_to` passes through to
+    kinit — the sharded pipeline pads to `mesh.size * GB` so the total
+    block count divides the mesh and EVERY window (the tail included)
+    splits into whole equal per-device block slices."""
     with _on_host():
-        leaves, g = pkernel.kinit(cfg, st, metrics, flight)
+        leaves, g = pkernel.kinit(cfg, st, metrics, flight,
+                                  pad_to=pad_to or GB)
     # np.array, not np.asarray: jax buffers surface as READ-ONLY views
     # and the store must accept _writeback's in-place window drains.
     return [np.array(leaf) for leaf in leaves], g
 
 
-def cohort_windows(cfg: RaftConfig, host_leaves) -> list:
+def cohort_windows(cfg: RaftConfig, host_leaves,
+                   n_devices: int = 1) -> list:
     """[(s0, s1), ...] sublane windows of the folded group axis
     (dim -2, the axis `kleaf_spec` shards): `cohort_blocks` whole
-    SUB-sublane blocks each, the last window taking the remainder."""
+    SUB-sublane blocks each, the last window taking the remainder. At
+    `n_devices > 1` the step is the GLOBAL sharded window —
+    `stream_blocks_per_device(cfg, N) * N` blocks, so each device's
+    slice of every window is whole blocks — and the leaves must carry
+    a multiple of N*SUB sublanes (host_wire `pad_to=N*GB`), which
+    keeps the tail window equally divisible too."""
     gs = host_leaves[0].shape[-2]
     if gs % SUB:
         raise ValueError(f"wire leaves carry {gs} sublanes — not whole "
                          f"{SUB}-sublane blocks; host_wire pads to {GB}")
-    step = cfg.cohort_blocks * SUB
+    if gs % (n_devices * SUB):
+        raise ValueError(
+            f"wire leaves carry {gs} sublanes — not divisible into "
+            f"whole blocks over {n_devices} devices; host_wire with "
+            f"pad_to={n_devices}*{GB} makes every window slice whole")
+    step = (pkernel.stream_blocks_per_device(cfg, n_devices)
+            * n_devices * SUB)
     return [(s0, min(s0 + step, gs)) for s0 in range(0, gs, step)]
 
 
@@ -232,6 +259,207 @@ def prun_streamed(cfg: RaftConfig, st: State, n_ticks: int, t0: int = 0,
     host_leaves, g = host_wire(cfg, st, metrics, flight)
     stream_ticks(cfg, host_leaves, g, t0, n_ticks, interpret=interpret,
                  chunk_ticks=chunk_ticks, stats=stats)
+    with _on_host():
+        leaves = tuple(map(np.asarray, host_leaves))
+        if flight is None:
+            return pkernel.kfinish(cfg, leaves, g, metrics)
+        st2, met2 = pkernel.kfinish(cfg, leaves, g, metrics)
+        return st2, met2, pkernel.kflight(cfg, leaves, g)
+
+
+# ---------------------------------------------------- sharded pipeline
+
+
+def sharded_engine(n_devices: int) -> str:
+    """Engine string of the sharded streamed runner — prefix `ENGINE`
+    plus the device count, so `obs.roofline.engine_class` classifies it
+    "pallas" (same per-launch byte model) and history's regression gate
+    compares like against like."""
+    return f"{ENGINE}-sharded-{n_devices}dev"
+
+
+def _heartbeat_sharded(eng: str, ci: int, tick_at: int, cfg: RaftConfig,
+                       window_leaves, g: int, s0: int, s1: int):
+    """Per-device heartbeat lanes (ISSUE r17 satellite): one beat_wire
+    per mesh device off its OWN shards of the just-finished window,
+    labeled `{eng}:c{ci}:d{device_id}` — so a multi-chip soak's
+    heartbeat JSONL names the slow or unsafe device mid-flight. No-op
+    without an installed heartbeat (the shard walk costs nothing
+    then); NOTE the beat's readback syncs that device's launches, the
+    standard beat_wire caveat."""
+    from raft_tpu.obs import trace as obs_trace
+    if obs_trace._HEARTBEAT is None:
+        return
+    per_leaves: dict = {}
+    bounds: dict = {}
+    for leaf in window_leaves:
+        shards = getattr(leaf, "addressable_shards", None)
+        if not shards:
+            return
+        for shard in shards:
+            key = getattr(shard.device, "id", shard.device)
+            per_leaves.setdefault(key, []).append(shard.data)
+            bounds[key] = shard.index[-2].indices(s1 - s0)[:2]
+    for key in sorted(per_leaves):
+        lo, hi = bounds[key]
+        g_dev = min(max(g - (s0 + lo) * LANE, 0), (hi - lo) * LANE)
+        if g_dev > 0:
+            obs_trace.heartbeat_wire(f"{eng}:c{ci}:d{key}", tick_at,
+                                     cfg, tuple(per_leaves[key]), g_dev)
+
+
+def stream_ticks_sharded(cfg: RaftConfig, host_leaves, g: int, t0: int,
+                         n_ticks: int, mesh, interpret: bool = False,
+                         chunk_ticks: int | None = None,
+                         stats: dict | None = None,
+                         staging: bool = True):
+    """`stream_ticks` with every window SPLIT over `mesh`: the same
+    double-buffered prefetch/launch/drain pipeline, but h2d goes
+    through `stream_sched.put_window` (staged per-device device_puts
+    committed as one kleaf-sharded array), the launch is
+    `kmesh.kstep_sharded` (each device runs the unchanged kernel grid
+    over its own blocks, zero collectives), and d2h drains per
+    addressable shard — N h2d streams, N kernel programs, and N d2h
+    streams in flight concurrently. Mutates `host_leaves` (which must
+    come from `host_wire(..., pad_to=mesh.size*GB)`) in place.
+
+    `staging=False` drops to the naive whole-window `device_put` path
+    (the ablation baseline; `stream_sched.staging_ablation` measures
+    the two against each other). `stats` additionally accumulates the
+    per-device copy split: `per_device` rows (h2d_s/d2h_s/copy_s per
+    device id), `slowest_device` (max copy_s — the device that owns
+    the window wall), and `overlap_efficiency_per_device_measured`
+    (compute_s / max(compute_s, that device's copy_s); the pipeline's
+    overall measured efficiency is bounded by the minimum entry)."""
+    import jax
+
+    from raft_tpu.obs import trace as obs_trace
+    from raft_tpu.parallel import stream_sched
+    from raft_tpu.parallel.kmesh import kstep_sharded
+
+    if n_ticks <= 0:
+        return host_leaves
+    nd = mesh.size
+    eng = sharded_engine(nd)
+    chunk = chunk_ticks or n_ticks
+    wins = cohort_windows(cfg, host_leaves, n_devices=nd)
+    pool = stream_sched.StagingPool(host_leaves, wins[0][1] - wins[0][0]) \
+        if staging else None
+    h2d_dev: dict = {}
+    d2h_dev: dict = {}
+    t_h2d = t_compute = t_d2h = 0.0
+    launches = 0
+    wall0 = time.perf_counter()
+    tic = time.perf_counter()
+    nxt = stream_sched.put_window(host_leaves, *wins[0], mesh, pool=pool,
+                                  slot=0, per_device=h2d_dev)
+    t_h2d += time.perf_counter() - tic
+    pending = None   # (evolved_leaves, s0, s1) of window i-1, d2h owed
+    for ci, (s0, s1) in enumerate(wins):
+        cur = nxt
+        if ci + 1 < len(wins):
+            tic = time.perf_counter()
+            nxt = stream_sched.put_window(host_leaves, *wins[ci + 1],
+                                          mesh, pool=pool, slot=ci + 1,
+                                          per_device=h2d_dev)
+            t_h2d += time.perf_counter() - tic
+        at = t0
+        while at < t0 + n_ticks:
+            n = min(chunk, t0 + n_ticks - at)
+            with obs_trace.chunk_span(eng, at, n, cohort=ci,
+                                      blocks=(s1 - s0) // SUB,
+                                      devices=nd,
+                                      interpret=bool(interpret)):
+                cur = kstep_sharded(cfg, cur, at, n, mesh,
+                                    interpret=interpret)
+            launches += 1
+            at += n
+        _heartbeat_sharded(eng, ci, t0 + n_ticks, cfg, cur, g, s0, s1)
+        if pending is not None:
+            tic = time.perf_counter()
+            stream_sched.drain_window(host_leaves, *pending,
+                                      per_device=d2h_dev)
+            t_d2h += time.perf_counter() - tic
+        tic = time.perf_counter()
+        jax.block_until_ready(cur)
+        t_compute += time.perf_counter() - tic
+        pending = (cur, s0, s1)
+    tic = time.perf_counter()
+    stream_sched.drain_window(host_leaves, *pending, per_device=d2h_dev)
+    t_d2h += time.perf_counter() - tic
+    wall = time.perf_counter() - wall0
+    if stats is not None:
+        stats["cohorts"] = stats.get("cohorts", 0) + len(wins)
+        stats["launches"] = stats.get("launches", 0) + launches
+        stats["h2d_s"] = stats.get("h2d_s", 0.0) + t_h2d
+        stats["compute_s"] = stats.get("compute_s", 0.0) + t_compute
+        stats["d2h_s"] = stats.get("d2h_s", 0.0) + t_d2h
+        stats["wall_s"] = stats.get("wall_s", 0.0) + wall
+        stats["overlap_efficiency_measured"] = (
+            stats["compute_s"] / stats["wall_s"] if stats["wall_s"] > 0
+            else None)
+        stats["n_devices"] = nd
+        stats["staging"] = bool(staging)
+        acc = stats.setdefault("_per_device_s", {})
+        for k in set(h2d_dev) | set(d2h_dev):
+            rec = acc.setdefault(k, {"h2d_s": 0.0, "d2h_s": 0.0})
+            rec["h2d_s"] += h2d_dev.get(k, 0.0)
+            rec["d2h_s"] += d2h_dev.get(k, 0.0)
+        comp = stats["compute_s"]
+        per = [{"device": k,
+                "h2d_s": round(v["h2d_s"], 6),
+                "d2h_s": round(v["d2h_s"], 6),
+                "copy_s": round(v["h2d_s"] + v["d2h_s"], 6)}
+               for k, v in sorted(acc.items())]
+        stats["per_device"] = per
+        if per:
+            stats["slowest_device"] = max(
+                per, key=lambda r: r["copy_s"])["device"]
+            stats["overlap_efficiency_per_device_measured"] = [
+                (round(comp / max(comp, r["copy_s"]), 4)
+                 if comp > 0 else None) for r in per]
+    return host_leaves
+
+
+def prun_streamed_sharded(cfg: RaftConfig, st: State, n_ticks: int,
+                          mesh, t0: int = 0,
+                          metrics: Metrics | None = None,
+                          interpret: bool = False,
+                          flight: Flight | None = None,
+                          chunk_ticks: int | None = None,
+                          stats: dict | None = None,
+                          staging: bool = True):
+    """Drop-in for `kmesh.prun_sharded` on streamed configs — the r17
+    tentpole: same (State, Metrics[, Flight]) out, same bits, but the
+    fleet lives in host RAM and every double-buffered window pages
+    through ALL of `mesh`'s devices concurrently (DESIGN.md §16).
+    Raises ValueError on unsupported shapes (`supported()` at
+    `n_devices=mesh.size` budgets the per-device host-RAM share for G
+    and per-device HBM only for the window slice). `stats` receives
+    the measured split including the per-device copy lanes;
+    `staging=False` selects the naive `device_put` copy path."""
+    g = st.alive_prev.shape[0]
+    wf = flight is not None
+    nd = mesh.size
+    scfg = cfg if cfg.stream_groups else None
+    if scfg is None:
+        import dataclasses
+        scfg = dataclasses.replace(cfg, stream_groups=True)
+    if not pkernel.supported(scfg, n_groups=g, n_devices=nd,
+                             with_flight=wf):
+        raise ValueError(
+            f"cohort: shape unsupported on {nd} device(s) (k > 30, "
+            f"VMEM footprint {pkernel.kernel_vmem_bytes(cfg)} B > "
+            f"{pkernel.VMEM_LIMIT_BYTES} B, per-device cohort window "
+            f"{pkernel.cohort_hbm_bytes(cfg, wf, nd)} B > "
+            f"{pkernel.HBM_LIMIT_BYTES} B HBM, or per-device host "
+            f"wire share {pkernel.host_bytes(scfg, -(-g // nd), wf)} B "
+            f"> {pkernel.HOST_RAM_LIMIT_BYTES} B host RAM)")
+    host_leaves, g = host_wire(cfg, st, metrics, flight,
+                               pad_to=nd * GB)
+    stream_ticks_sharded(cfg, host_leaves, g, t0, n_ticks, mesh,
+                         interpret=interpret, chunk_ticks=chunk_ticks,
+                         stats=stats, staging=staging)
     with _on_host():
         leaves = tuple(map(np.asarray, host_leaves))
         if flight is None:

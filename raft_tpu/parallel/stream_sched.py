@@ -1,0 +1,214 @@
+"""Shard-aware cohort window scheduler: the copy path of the r17
+sharded streaming pipeline (DESIGN.md §16).
+
+`parallel/cohort.py` owns the pipeline's control flow (which window is
+resident, when to prefetch, when to drain); this module owns how one
+window's bytes actually cross the host<->device boundary when the
+window is SPLIT over the r08 device mesh:
+
+- `window_sharding`/`device_slices`: the placement rule. Every wire
+  leaf carries the folded group axis at dim -2, so `kmesh.kleaf_spec`
+  is the one PartitionSpec for streamed windows too, and the
+  per-device index map is asked FROM the sharding
+  (`addressable_devices_indices_map`) rather than re-derived — a mesh
+  ordering the slicer assumed but the sharding disagreed with would
+  scatter blocks to the wrong devices silently.
+- `StagingPool` + `put_window`: the h2d commit point. The naive path
+  (`staged=False`) hands `jax.device_put` one strided host view per
+  leaf and lets jax allocate + linearize a transfer buffer per window
+  — allocator churn on every prefetch. The staged path copies the
+  window into REUSABLE preallocated contiguous buffers (two
+  parity-alternated slots, the double-buffered pipeline's depth), then
+  issues one per-device `jax.device_put(slice, device)` per leaf —
+  all N dispatches in flight before any is awaited, so the N h2d
+  streams never serialize — and commits them as ONE global sharded
+  array via `jax.make_array_from_single_device_arrays` (the
+  `dma_start`-style commit: the assembled array is a handle over
+  transfers already in flight, not a barrier). `staging_ablation`
+  measures the two paths against each other; DESIGN.md §16 records the
+  protocol and the driver's TPU column.
+- `drain_window`: the d2h twin. One `np.asarray` per addressable
+  shard, written straight into the host store at the shard's own
+  index (offset into the window) — per-device drains, each blocking
+  only on its own device's launches, with per-device wall captured so
+  a soak can name the slow device (`stats`/heartbeat lanes in
+  cohort.stream_ticks_sharded).
+
+Slot-reuse safety: `put_window` for window i+1 reuses the parity slot
+window i-1 staged into. By then window i-1's `device_put`s have long
+returned (jax copies the host buffer into its transfer staging before
+returning) AND window i-1's launches were synced by the pipeline
+(`jax.block_until_ready` at the end of its residency), so no transfer
+still reads the slot. Depth-2 is exactly the pipeline's lookahead; a
+deeper prefetch would need more slots.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from raft_tpu.sim.pkernel import GB, SUB
+
+
+def window_sharding(mesh, leaf):
+    """The NamedSharding a streamed window leaf pages in under: the
+    r08 `kleaf_spec` rule (folded GS axis at dim -2) on `mesh` — the
+    SAME sharding `kmesh.kstep_sharded`'s shard_map uses, so a paged-in
+    window launches with zero resharding."""
+    from jax.sharding import NamedSharding
+
+    from raft_tpu.parallel.kmesh import kleaf_spec
+    return NamedSharding(mesh, kleaf_spec(leaf))
+
+
+def device_slices(mesh, leaf, s0: int, s1: int):
+    """[(device, (lo, hi)), ...] — each device's sublane range of the
+    window [s0, s1), in the sharding's own addressable-device order,
+    RELATIVE to the window (add s0 for host-store coordinates). Asks
+    the sharding for its index map instead of assuming one; every
+    slice must be whole 1024-group blocks (contracts.streaming_problems
+    audits this via the public seam)."""
+    shape = leaf.shape[:-2] + (s1 - s0,) + leaf.shape[-1:]
+    sharding = window_sharding(mesh, leaf)
+    out = []
+    for dev, idx in sharding.addressable_devices_indices_map(shape).items():
+        lo, hi, _ = idx[-2].indices(s1 - s0)
+        out.append((dev, (lo, hi)))
+    return out
+
+
+class StagingPool:
+    """Reusable preallocated contiguous host staging buffers for the
+    h2d path: one buffer per wire leaf per parity slot, sized for the
+    FULL window shape (tail windows use a leading view). Kills the
+    per-window allocate-and-linearize cost of the naive `device_put`
+    path; see the module docstring for the depth-2 reuse argument."""
+
+    SLOTS = 2
+
+    def __init__(self, host_leaves, window_sublanes: int):
+        self._bufs = [
+            tuple(np.empty(a.shape[:-2] + (window_sublanes,)
+                           + a.shape[-1:], a.dtype)
+                  for a in host_leaves)
+            for _ in range(self.SLOTS)]
+
+    def stage(self, host_leaves, s0: int, s1: int, slot: int):
+        """Copy the window [s0, s1) into parity slot `slot % SLOTS`;
+        returns contiguous views (the transfer sources)."""
+        views = []
+        for host, buf in zip(host_leaves, self._bufs[slot % self.SLOTS]):
+            dst = buf[..., : s1 - s0, :]
+            np.copyto(dst, host[..., s0:s1, :])
+            views.append(dst)
+        return tuple(views)
+
+
+def put_window(host_leaves, s0: int, s1: int, mesh, pool=None,
+               slot: int = 0, per_device=None):
+    """h2d of one cohort window onto `mesh`, every leaf sharded by the
+    kleaf rule. With `pool` (a StagingPool) the staged commit path runs
+    — per-device `device_put`s off the contiguous slot, assembled with
+    `make_array_from_single_device_arrays`; without, the naive path
+    (one sharded `device_put` per strided leaf view). Both return the
+    same tuple of global sharded arrays; both only DISPATCH (nothing
+    here blocks on the transfer). `per_device`, when a dict,
+    accumulates per-device h2d dispatch seconds keyed by device id."""
+    import jax
+
+    if pool is None:
+        return tuple(
+            jax.device_put(np.ascontiguousarray(leaf[..., s0:s1, :]),
+                           window_sharding(mesh, leaf))
+            for leaf in host_leaves)
+    staged = pool.stage(host_leaves, s0, s1, slot)
+    out = []
+    for leaf, src in zip(host_leaves, staged):
+        sharding = window_sharding(mesh, leaf)
+        shape = src.shape
+        shards = []
+        for dev, idx in sharding.addressable_devices_indices_map(
+                shape).items():
+            tic = time.perf_counter()
+            shards.append(jax.device_put(src[idx], dev))
+            if per_device is not None:
+                key = getattr(dev, "id", dev)
+                per_device[key] = (per_device.get(key, 0.0)
+                                   + time.perf_counter() - tic)
+        out.append(jax.make_array_from_single_device_arrays(
+            shape, sharding, shards))
+    return tuple(out)
+
+
+def drain_window(host_leaves, window_leaves, s0: int, s1: int,
+                 per_device=None):
+    """d2h of one evolved sharded window back into the host store:
+    one `np.asarray` per addressable shard, each blocking only on its
+    OWN device's launches + transfer, written at the shard's index
+    offset by `s0`. `per_device`, when a dict, accumulates per-device
+    drain seconds keyed by device id — the slow-device instrument."""
+    for host, dev_leaf in zip(host_leaves, window_leaves):
+        shards = getattr(dev_leaf, "addressable_shards", None)
+        if not shards:   # unsharded (1-device) window: plain writeback
+            host[..., s0:s1, :] = np.asarray(dev_leaf)
+            continue
+        for shard in shards:
+            lo, hi, _ = shard.index[-2].indices(s1 - s0)
+            tic = time.perf_counter()
+            host[..., s0 + lo:s0 + hi, :] = np.asarray(shard.data)
+            if per_device is not None:
+                key = getattr(shard.device, "id", shard.device)
+                per_device[key] = (per_device.get(key, 0.0)
+                                   + time.perf_counter() - tic)
+
+
+def staging_ablation(cfg, mesh, n_windows: int = 4,
+                     repeats: int = 3) -> dict:
+    """Measure the staged commit path against the naive `device_put`
+    loop (DESIGN.md §16's copy-path measurement protocol): page
+    `n_windows` full cohort windows h2d through each path, block until
+    delivered, take the best of `repeats` passes. Pure copy-path
+    probe — no kernel launches, so it runs anywhere the mesh exists
+    (virtual CPU devices included; only the TPU column is a bandwidth
+    claim). Returns wall seconds + MiB/s per path and the ratio."""
+    import jax
+
+    from raft_tpu import sim
+    from raft_tpu.parallel import cohort
+    from raft_tpu.sim import pkernel
+
+    nd = mesh.size
+    bpd = pkernel.stream_blocks_per_device(cfg, nd)
+    win = bpd * nd * SUB
+    g = min(n_windows, 4) * bpd * nd * GB
+    host, _ = cohort.host_wire(cfg, sim.init(cfg, n_groups=g),
+                               pad_to=nd * GB)
+    wins = [(s0, min(s0 + win, host[0].shape[-2]))
+            for s0 in range(0, host[0].shape[-2], win)]
+    window_bytes = sum(a.dtype.itemsize * a[..., :win, :].size
+                       for a in host)
+    pool = StagingPool(host, win)
+    walls = {}
+    for label, use_pool in (("staged", True), ("naive", False)):
+        best = None
+        for _ in range(repeats):
+            tic = time.perf_counter()
+            for i, (s0, s1) in enumerate(wins):
+                dev = put_window(host, s0, s1, mesh,
+                                 pool=pool if use_pool else None, slot=i)
+                jax.block_until_ready(dev)
+            wall = time.perf_counter() - tic
+            best = wall if best is None else min(best, wall)
+        walls[label] = best
+    moved = len(wins) * window_bytes
+    return {
+        "n_devices": nd, "windows": len(wins),
+        "window_bytes": window_bytes,
+        "staged_wall_s": round(walls["staged"], 6),
+        "naive_wall_s": round(walls["naive"], 6),
+        "staged_mib_s": round(moved / walls["staged"] / 2**20, 1),
+        "naive_mib_s": round(moved / walls["naive"] / 2**20, 1),
+        "staged_over_naive": round(walls["naive"] / walls["staged"], 3),
+    }

@@ -337,13 +337,25 @@ def host_bytes(cfg: RaftConfig, n_groups: int,
     return 4 * wire_words_per_group(cfg, with_flight) * padded
 
 
-def cohort_hbm_bytes(cfg: RaftConfig, with_flight: bool = True) -> int:
+def stream_blocks_per_device(cfg: RaftConfig, n_devices: int = 1) -> int:
+    """Whole 1024-group blocks of one cohort window that land on EACH
+    device: `cohort_blocks` split over the mesh, rounded UP so every
+    per-device window slice is a whole number of kernel blocks (the
+    r17 sharded scheduler's global window is this figure x n_devices —
+    at n_devices=1 it is exactly `cfg.cohort_blocks`)."""
+    return -(-cfg.cohort_blocks // n_devices)
+
+
+def cohort_hbm_bytes(cfg: RaftConfig, with_flight: bool = True,
+                     n_devices: int = 1) -> int:
     """Peak per-device HBM bytes the streamed pipeline holds: the
-    cohort window (cohort_blocks whole blocks) times the pipeline's
-    live-window count (`_stream_windows`) — O(cohort_blocks), never
-    O(G). This replaces `hbm_bytes` as the HBM side of `supported()`
-    under cfg.stream_groups."""
-    window = cfg.cohort_blocks * GB
+    PER-DEVICE window slice (`stream_blocks_per_device` whole blocks —
+    the full cohort window at n_devices=1, cohort_blocks/N rounded up
+    under the r17 sharded scheduler) times the pipeline's live-window
+    count (`_stream_windows`) — O(cohort_blocks), never O(G). This
+    replaces `hbm_bytes` as the HBM side of `supported()` under
+    cfg.stream_groups."""
+    window = stream_blocks_per_device(cfg, n_devices) * GB
     return (_stream_windows(cfg) * 4
             * wire_words_per_group(cfg, with_flight) * window)
 
@@ -352,14 +364,17 @@ def streamed_ceiling_groups(cfg: RaftConfig, n_devices: int = 1,
                             with_flight: bool = True) -> int:
     """Largest group count `supported()` admits under cfg.stream_groups
     on `n_devices`: host-RAM-bound (ONE wire copy per group in host
-    RAM), in whole 1024-group blocks, consistent with `host_bytes`'s
-    padding — same exact-boundary contract as `hbm_ceiling_groups`,
-    budget $RAFT_TPU_HOST_RAM_BYTES instead of $RAFT_TPU_HBM_BYTES.
-    The cohort window must also fit HBM (`cohort_hbm_bytes`) or no
-    group count is admitted at all. The single source for every
-    printed/emitted streamed ceiling (layout_probe, multichip_sweep,
-    analysis/bytemodel)."""
-    if cohort_hbm_bytes(cfg, with_flight) > HBM_LIMIT_BYTES:
+    RAM, a PER-DEVICE allocation — the multi-host/pod model where each
+    chip's host slice carries $RAFT_TPU_HOST_RAM_BYTES, matching
+    `supported()`'s ceil(G / n_devices) budget), in whole 1024-group
+    blocks, consistent with `host_bytes`'s padding — same
+    exact-boundary contract as `hbm_ceiling_groups`, budget
+    $RAFT_TPU_HOST_RAM_BYTES instead of $RAFT_TPU_HBM_BYTES. The
+    PER-DEVICE cohort window must also fit HBM (`cohort_hbm_bytes` at
+    `n_devices`) or no group count is admitted at all. The single
+    source for every printed/emitted streamed ceiling (layout_probe,
+    multichip_sweep, analysis/bytemodel)."""
+    if cohort_hbm_bytes(cfg, with_flight, n_devices) > HBM_LIMIT_BYTES:
         return 0
     per_block = 4 * wire_words_per_group(cfg, with_flight) * GB
     return (HOST_RAM_LIMIT_BYTES // per_block) * GB * n_devices
@@ -396,10 +411,11 @@ def supported(cfg: RaftConfig, n_groups: int | None = None,
     if not (cfg.k <= 30 and kernel_vmem_bytes(cfg) <= VMEM_LIMIT_BYTES):
         return False
     if cfg.stream_groups:
-        # Streamed residency (DESIGN.md §15): the cohort window must fit
-        # HBM whatever G is; G itself is bounded by host RAM (one wire
-        # copy of the whole padded fleet), not by HBM.
-        if cohort_hbm_bytes(cfg, with_flight) > HBM_LIMIT_BYTES:
+        # Streamed residency (DESIGN.md §15/§16): the PER-DEVICE cohort
+        # window must fit HBM whatever G is; G itself is bounded by
+        # host RAM (one wire copy of each device's padded shard on its
+        # host slice), not by HBM.
+        if cohort_hbm_bytes(cfg, with_flight, n_devices) > HBM_LIMIT_BYTES:
             return False
         if n_groups is None:
             return True

@@ -124,7 +124,11 @@ def run_universe(cfg: RaftConfig, n_groups: int, ticks: int,
     `stream` (the `--stream` axis, ISSUE r16) the kernel half runs
     through the cohort scheduler (parallel/cohort.py) at
     cohort_blocks=1 and >=2 launches per window, so the comparison
-    certifies that host<->HBM paging is invisible too."""
+    certifies that host<->HBM paging is invisible too. `stream` AND
+    `devices > 1` compose (r17): the kernel half runs
+    `prun_streamed_sharded` — every device pages its own whole-block
+    window slice — so the comparison certifies that SHARDED paging is
+    invisible as well."""
     t0 = time.perf_counter()
     st0 = sim.init(cfg, n_groups=n_groups)
     stx, mx = run(cfg, st0, ticks, 0,
@@ -135,9 +139,16 @@ def run_universe(cfg: RaftConfig, n_groups: int, ticks: int,
         from raft_tpu.parallel import cohort
         scfg = dataclasses.replace(cfg, stream_groups=True,
                                    cohort_blocks=1)
-        stp, mp = cohort.prun_streamed(scfg, st0, ticks,
-                                       interpret=interpret,
-                                       chunk_ticks=max(1, ticks // 2))
+        if devices > 1:
+            from raft_tpu import parallel
+            mesh = parallel.make_mesh(devices)
+            stp, mp = cohort.prun_streamed_sharded(
+                scfg, st0, ticks, mesh, interpret=interpret,
+                chunk_ticks=max(1, ticks // 2))
+        else:
+            stp, mp = cohort.prun_streamed(scfg, st0, ticks,
+                                           interpret=interpret,
+                                           chunk_ticks=max(1, ticks // 2))
     elif devices > 1:
         from raft_tpu import parallel
         from raft_tpu.parallel import kmesh
@@ -269,12 +280,11 @@ def main():
                     "cohort_blocks=1, >=2 launches per window) — the "
                     "streamed x feature x fault cells, same full "
                     "State+Metrics bit-identity gate against the "
-                    "resident XLA reference")
+                    "resident XLA reference; composes with --devices N "
+                    "(r17): each device pages its own whole-block "
+                    "window slice (prun_streamed_sharded)")
     args = ap.parse_args()
     _check_pairwise(ROWS)
-    if args.stream and args.devices > 1:
-        ap.error("--stream is single-device (host paging composes per "
-                 "chip; the sharded path stays resident)")
 
     if args.devices > 1 and len(jax.devices()) < args.devices:
         if jax.devices()[0].platform == "tpu":

@@ -152,6 +152,22 @@ def bytes_per_group_report(cfg=None):
     st = pkernel.hbm_ceiling_groups(adcfg, with_flight=False)
     print(f"  all dials, flight off:   {ad:>12,d} groups "
           f"(vs {st:,d} static resident = {ad / st:.2f}x)")
+    # r17 sharded paging (DESIGN.md §16): every chip pages its own
+    # whole-block window slice, and host RAM is a PER-DEVICE allocation
+    # (one host per chip group on a pod) — so the streamed ceiling
+    # scales with the device axis, boundary-exact at every N.
+    one = pkernel.streamed_ceiling_groups(scfg)
+    for d in (4, 8):
+        ceil_d = pkernel.streamed_ceiling_groups(scfg, n_devices=d)
+        boundary = (pkernel.supported(scfg, n_groups=ceil_d, n_devices=d)
+                    and not pkernel.supported(scfg,
+                                              n_groups=ceil_d + pkernel.GB,
+                                              n_devices=d))
+        print(f"  x{d} devices (sharded paging, flight on): "
+              f"{ceil_d:>12,d} groups ({ceil_d / one:.2f}x 1-dev, "
+              f"{pkernel.stream_blocks_per_device(scfg, d)} blocks/device"
+              f"/window, "
+              f"{'exact supported() boundary' if boundary else 'BOUNDARY DRIFT'})")
 
     # Client-traffic delta (DESIGN.md §10): the headline config with
     # the bench client-SLO segment's workload knobs on.
@@ -316,7 +332,35 @@ def main():
                     help="group count for the measured ablation column")
     ap.add_argument("--ablate-ticks", type=int, default=600,
                     help="timed ticks for the measured ablation column")
+    ap.add_argument("--staging-ablation", action="store_true",
+                    help="measure the r17 copy path (DESIGN.md §16): "
+                    "staged per-device window commits (preallocated "
+                    "host staging + N concurrent device_put streams) "
+                    "vs the naive device_put loop, on every visible "
+                    "device; exit")
     args = ap.parse_args()
+    if args.staging_ablation:
+        import dataclasses as _dc
+
+        from raft_tpu import parallel
+        from raft_tpu.config import RaftConfig
+        from raft_tpu.parallel import stream_sched
+        nd = len(jax.devices())
+        mesh = parallel.make_mesh(nd)
+        cfg = _dc.replace(RaftConfig(seed=42),
+                          stream_groups=True, cohort_blocks=1)
+        rep = stream_sched.staging_ablation(cfg, mesh)
+        print(f"staging ablation ({rep['n_devices']} device(s), "
+              f"{rep['window_bytes'] / 2**20:.1f} MiB/window x "
+              f"{rep['windows']} windows, best of 3):")
+        print(f"  staged: {rep['staged_wall_s'] * 1e3:8.1f} ms  "
+              f"({rep['staged_mib_s']:,.0f} MiB/s)")
+        print(f"  naive:  {rep['naive_wall_s'] * 1e3:8.1f} ms  "
+              f"({rep['naive_mib_s']:,.0f} MiB/s)")
+        print(f"  staged/naive speedup: {rep['staged_over_naive']:.3f}x "
+              f"(>1 = staged wins; the TPU column is the bandwidth "
+              f"claim, a CPU box only proves the protocol)")
+        return
     if args.ablate:
         ablation_table(True, args.ablate_groups, args.ablate_ticks)
         return

@@ -78,6 +78,29 @@ def _predicted(cfg):
         "model": "host RAM holds ONE wire copy of G (whole blocks); "
                  "HBM holds only stream_windows cohort windows — see "
                  "scripts/layout_probe.py for the boundary pins",
+        # r17 sharded paging (DESIGN.md §16): per-device ceilings when
+        # every chip pages its own whole-block window slice — host RAM
+        # is a PER-DEVICE allocation (one host per chip group on a
+        # pod), so the modeled ceiling scales with the device axis.
+        # Re-derived independently by analysis/bytemodel
+        # (hbm.streamed.sharded) and pinned by tests/test_stream_mesh.
+        "sharded": {
+            str(nd): {
+                "ceiling_groups_no_flight":
+                    pkernel.streamed_ceiling_groups(
+                        scfg, n_devices=nd, with_flight=False),
+                "blocks_per_device":
+                    pkernel.stream_blocks_per_device(scfg, nd),
+                "window_hbm_bytes_per_device":
+                    pkernel.cohort_hbm_bytes(
+                        scfg, with_flight=False, n_devices=nd),
+                "speedup_vs_1dev":
+                    pkernel.streamed_ceiling_groups(
+                        scfg, n_devices=nd, with_flight=False)
+                    / max(1, pkernel.streamed_ceiling_groups(
+                        scfg, with_flight=False)),
+            } for nd in D_LIST
+        },
     }
     out = {
         "wire_bytes_per_group":
@@ -385,6 +408,66 @@ def streamed_gate(dials: dict | None = None):
             "wall_s": round(time.perf_counter() - t0, 3)}
 
 
+def streamed_sharded_gate(n_devices: int = 2, dials: dict | None = None):
+    """The r17 SHARDED cohort-paging differential a CPU box can afford
+    (DESIGN.md §16): interpret mode, THREE-WAY — `prun_streamed_sharded`
+    (every device paging its own whole-block window slice) vs the
+    RESIDENT sharded kernel (`kmesh.prun_sharded`) vs the recorded XLA
+    scan, full State + full Metrics + flight ring bit-identical. The
+    shape is deliberately multi-cohort AND multi-launch (G=2500 pads to
+    4 blocks -> 2 windows of 2 blocks at cohort_blocks=2 x 2 devices;
+    chunk_ticks=ticks/2 -> 2 launches per window) so the differential
+    exercises window hand-off, per-device slicing, the staging pool,
+    and mid-window re-launch — not just a single resident pass."""
+    import dataclasses
+
+    from raft_tpu import parallel, sim
+    from raft_tpu.obs.recorder import flight_init, run_recorded
+    from raft_tpu.parallel import cohort, kmesh
+    from raft_tpu.sim.run import unsafe_groups
+    from raft_tpu.utils.trees import trees_equal_why
+
+    cfg = _dry_cfg()
+    if dials:
+        cfg = dataclasses.replace(cfg, **dials)
+    scfg = dataclasses.replace(cfg, stream_groups=True, cohort_blocks=2)
+    n_groups, ticks = 2500, 24
+    mesh = parallel.make_mesh(n_devices)
+    t0 = time.perf_counter()
+    st0 = sim.init(cfg, n_groups=n_groups)
+    st_s, m_s, f_s = cohort.prun_streamed_sharded(
+        scfg, st0, ticks, mesh, interpret=True,
+        flight=flight_init(n_groups), chunk_ticks=ticks // 2)
+    verdicts = {}
+    st_k, m_k, f_k = kmesh.prun_sharded(cfg, st0, ticks, mesh,
+                                        interpret=True,
+                                        flight=flight_init(n_groups))
+    ok = [trees_equal_why(st_k, st_s),
+          trees_equal_why(m_k, m_s, names=list(type(m_k)._fields)),
+          trees_equal_why(f_k, f_s)]
+    verdicts["vs_kernel_sharded_resident"] = all(o for o, _ in ok)
+    for o, why in ok:
+        if not o:
+            log(f"    resident-sharded mismatch: {why}")
+    st_x, m_x, f_x = run_recorded(cfg, st0, ticks,
+                                  flight=flight_init(n_groups))
+    m_x = _hist_comparable(cfg, m_x, m_s)
+    ok = [trees_equal_why(st_x, st_s),
+          trees_equal_why(m_x, m_s, names=list(type(m_x)._fields)),
+          trees_equal_why(f_x, f_s)]
+    verdicts["vs_xla"] = all(o for o, _ in ok)
+    for o, why in ok:
+        if not o:
+            log(f"    xla mismatch: {why}")
+    return {"mode": "interpret-streamed-sharded",
+            "engine": cohort.sharded_engine(n_devices),
+            "groups": n_groups, "ticks": ticks, "cohort_blocks": 2,
+            "devices": n_devices, "launches_per_window": 2,
+            "state_identical": all(verdicts.values()), **verdicts,
+            "safety_ok": unsafe_groups(m_s) == 0,
+            "wall_s": round(time.perf_counter() - t0, 3)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="MULTICHIP_r07.json")
@@ -510,6 +593,33 @@ def main():
                      "status": f"error: {type(e).__name__}: {e}"}
             log(f"  streamed gate FAILED: {sgate['status']}")
 
+    ssgate = None
+    if not on_tpu:
+        # The sharded-streamed column (r17): three-way state_identical
+        # — per-device paging vs the RESIDENT sharded kernel vs the
+        # recorded XLA scan — full State + Metrics + flight ring, at a
+        # multi-window multi-launch shape (2 blocks/window x 2
+        # launches/window on a 2-device mesh).
+        nd_gate = min(2, n_avail)
+        log(f"interpret-mode sharded-streamed gate ({nd_gate} devices, "
+            f"2500 groups, 3-way + flight"
+            f"{', dialed layout' if dialed else ''}):")
+        try:
+            ssgate = streamed_sharded_gate(nd_gate,
+                                           dials if dialed else None)
+            log(f"  state_identical={ssgate['state_identical']} "
+                f"(vs_kernel_sharded_resident="
+                f"{ssgate['vs_kernel_sharded_resident']} "
+                f"vs_xla={ssgate['vs_xla']}) "
+                f"safety_ok={ssgate['safety_ok']} ({ssgate['wall_s']}s)")
+        except Exception as e:
+            # Same tri-state convention: an ERROR is recorded
+            # evidence, never a divergence verdict.
+            ssgate = {"mode": "interpret-streamed-sharded",
+                      "state_identical": None, "safety_ok": None,
+                      "status": f"error: {type(e).__name__}: {e}"}
+            log(f"  sharded-streamed gate FAILED: {ssgate['status']}")
+
     out = {
         "schema": 1,
         "source": "scripts/multichip_sweep.py",
@@ -526,6 +636,7 @@ def main():
         "grid": grid,
         "interpret_gate": gate,
         "streamed_gate": sgate,
+        "streamed_sharded_gate": ssgate,
     }
     path = args.out
     if not os.path.isabs(path):
@@ -548,6 +659,9 @@ def main():
     if sgate is not None and (sgate["state_identical"] is False
                               or sgate["safety_ok"] is False):
         bad.append(sgate)   # the streamed column's verdict
+    if ssgate is not None and (ssgate["state_identical"] is False
+                               or ssgate["safety_ok"] is False):
+        bad.append(ssgate)   # the sharded-streamed column's verdict
     return 1 if bad else 0
 
 

@@ -1,0 +1,467 @@
+"""The r17 sharded cohort-paging layer (DESIGN.md §16): every mesh
+device pages its OWN whole-block window slice host<->HBM under the
+unchanged sharded kernel.
+
+The contract under test: sharding the paging must be invisible —
+`prun_streamed_sharded` stays bit-identical to the RESIDENT sharded
+kernel and the XLA path (full State + Metrics + flight ring) across
+the multi-window multi-launch shape — while the modeled ceiling scales
+with the device axis (host RAM is a PER-DEVICE allocation: one host
+per chip group on a pod), boundary-exact at every N and re-derived
+independently by analysis/bytemodel. The copy path (stream_sched)
+must round-trip every byte through both the staged and naive commit
+paths, split windows into whole 1024-group per-device blocks under the
+r08 kleaf rule, and the per-device telemetry (STREAM_MESH_KEYS,
+heartbeat lanes) must cover emit + backfill both directions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+
+import numpy as np
+import pytest
+
+import conftest  # noqa: F401  (pins the CPU platform before jax loads)
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.parallel import cohort, make_mesh, stream_sched
+from raft_tpu.sim import checkpoint, pkernel, state
+from raft_tpu.sim.run import metrics_init, run
+from raft_tpu.utils.trees import trees_equal, trees_equal_why
+
+# The shared fast-tier differential universe (kmesh.faulted_64_cfg's
+# shape): crash + partition + drop churn across the cohort windows.
+FAULTED = RaftConfig(n_groups=64, k=3, seed=23, drop_prob=0.05,
+                     crash_prob=0.2, crash_epoch=16, partition_prob=0.2,
+                     partition_epoch=16, log_cap=8, compact_every=4)
+
+ALL_DIALS = dict(pack_bools=True, pack_ring=True, alias_wire=True,
+                 wire_hist=False)
+
+
+def _headline():
+    return RaftConfig(seed=42)
+
+
+# ----------------------------------------------------- residency model
+
+
+def test_sharded_streamed_ceiling_scales_with_devices():
+    """THE r17 acceptance pin: at the headline wire over 64 GiB host
+    RAM per device, the modeled sharded-streamed ceiling is exactly
+    N x the single-device streamed ceiling — >= the 4x floor at 8
+    devices — and, like every ceiling in this repo, the EXACT
+    supported() boundary at every device count: one more block tips
+    the per-device host share into one more padded block."""
+    scfg = dataclasses.replace(_headline(), stream_groups=True)
+    one = pkernel.streamed_ceiling_groups(scfg)
+    for nd in (1, 2, 4, 8):
+        ceil = pkernel.streamed_ceiling_groups(scfg, n_devices=nd)
+        assert ceil == nd * one, nd
+        assert ceil % pkernel.GB == 0, nd
+        assert pkernel.supported(scfg, n_groups=ceil, n_devices=nd), nd
+        assert not pkernel.supported(scfg, n_groups=ceil + pkernel.GB,
+                                     n_devices=nd), nd
+        # The per-device cohort window (not the fleet) must fit HBM.
+        assert pkernel.cohort_hbm_bytes(scfg, n_devices=nd) \
+            <= pkernel.HBM_LIMIT_BYTES, nd
+    assert pkernel.streamed_ceiling_groups(scfg, n_devices=8) >= 4 * one
+    # Whole-block per-device split: ceil-divide, never a partial block.
+    assert pkernel.stream_blocks_per_device(scfg, 1) == scfg.cohort_blocks
+    assert pkernel.stream_blocks_per_device(
+        dataclasses.replace(scfg, cohort_blocks=3), 2) == 2
+
+
+def test_sharded_streamed_supported_boundary_per_device_share():
+    """supported() at n_devices budgets the PER-DEVICE host share
+    (ceil(G/N), whole padded blocks): a G the single device refuses is
+    fine over 8, and the 8-device boundary is where one device's share
+    pads past its host allocation."""
+    scfg = dataclasses.replace(_headline(), stream_groups=True)
+    one = pkernel.streamed_ceiling_groups(scfg)
+    assert not pkernel.supported(scfg, n_groups=one + pkernel.GB)
+    assert pkernel.supported(scfg, n_groups=one + pkernel.GB, n_devices=8)
+    ceil8 = pkernel.streamed_ceiling_groups(scfg, n_devices=8)
+    per_block = 4 * pkernel.wire_words_per_group(scfg) * pkernel.GB
+    share = -(-((ceil8 + pkernel.GB) // 8) // pkernel.GB) * per_block
+    assert share > pkernel.HOST_RAM_LIMIT_BYTES   # why ceil8+GB refuses
+
+
+def test_byte_model_rederives_sharded_ceiling():
+    """The engine-contract auditor's INDEPENDENT derivation agrees at
+    every audited layout: hbm.streamed.sharded re-derives the 8-device
+    ceiling from dtype x shape, finds it boundary-exact, and clears the
+    r17 >= 4x-of-1-device acceptance floor."""
+    from raft_tpu.analysis import bytemodel
+
+    for label, cfg in bytemodel.audit_cfgs():
+        model = bytemodel.derived_wire_model(cfg)
+        assert model["problems"] == [], (label, model["problems"])
+        s = model["hbm"]["streamed"]["sharded"]
+        assert s["n_devices"] == 8, label
+        assert s["boundary_exact"], label
+        assert s["speedup_vs_1dev"] >= 4.0, label
+        assert s["ceiling_groups"] \
+            == 8 * model["hbm"]["streamed"]["ceiling_groups"], label
+        assert s["window_hbm_bytes_per_device"] \
+            <= pkernel.HBM_LIMIT_BYTES, label
+
+
+# ------------------------------------------------------------ copy path
+
+
+def test_sharded_windows_split_into_whole_per_device_blocks():
+    """Window geometry: host_wire(pad_to=N*GB) makes every window —
+    tail included — split into EQUAL whole-1024-group-block per-device
+    slices under the r08 kleaf rule, on the sharding's own index map."""
+    nd = 2
+    mesh = make_mesh(nd)
+    cfg = dataclasses.replace(FAULTED, n_groups=2500, stream_groups=True,
+                              cohort_blocks=2)
+    host, g = cohort.host_wire(cfg, state.init(cfg, n_groups=2500),
+                               pad_to=nd * pkernel.GB)
+    assert host[0].shape[-2] % (nd * pkernel.SUB) == 0
+    wins = cohort.cohort_windows(cfg, host, n_devices=nd)
+    assert len(wins) >= 2
+    for s0, s1 in wins:
+        for leaf in host:
+            slices = stream_sched.device_slices(mesh, leaf, s0, s1)
+            assert len(slices) == nd
+            spans = sorted(hi - lo for _, (lo, hi) in slices)
+            assert spans[0] == spans[-1]            # equal shares
+            assert spans[0] % pkernel.SUB == 0      # whole blocks
+            covered = sorted((lo, hi) for _, (lo, hi) in slices)
+            assert covered[0][0] == 0 and covered[-1][1] == s1 - s0
+    # A wire padded for the wrong device count is refused loudly.
+    bad, _ = cohort.host_wire(cfg, state.init(cfg, n_groups=2500))
+    with pytest.raises(ValueError, match="pad_to"):
+        cohort.cohort_windows(cfg, bad, n_devices=nd)
+
+
+def test_staged_and_naive_put_drain_round_trip_identity():
+    """Both commit paths (StagingPool + per-device device_put streams
+    vs naive sharded device_put) place identical bytes under identical
+    shardings, and drain_window writes every byte back — paging moves
+    state, never edits it, tail window and all."""
+    import jax
+
+    from raft_tpu.parallel.kmesh import kleaf_spec
+
+    nd = 2
+    mesh = make_mesh(nd)
+    cfg = dataclasses.replace(FAULTED, n_groups=2500, stream_groups=True,
+                              cohort_blocks=2)
+    host, g = cohort.host_wire(cfg, state.init(cfg, n_groups=2500),
+                               pad_to=nd * pkernel.GB)
+    before = [a.copy() for a in host]
+    wins = cohort.cohort_windows(cfg, host, n_devices=nd)
+    pool = stream_sched.StagingPool(host, wins[0][1] - wins[0][0])
+    for i, (s0, s1) in enumerate(wins):
+        staged = stream_sched.put_window(host, s0, s1, mesh, pool=pool,
+                                         slot=i)
+        naive = stream_sched.put_window(host, s0, s1, mesh)
+        for a, b, src in zip(staged, naive, host):
+            assert a.sharding.spec == kleaf_spec(src)
+            assert b.sharding.spec == kleaf_spec(src)
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        per_dev: dict = {}
+        stream_sched.drain_window(host, staged, s0, s1,
+                                  per_device=per_dev)
+        assert len(per_dev) == nd   # every device drained its shard
+        jax.block_until_ready(naive)
+    for i, (a, b) in enumerate(zip(before, host)):
+        assert np.array_equal(a, b), i
+
+
+def test_staging_ablation_reports_both_paths():
+    """The copy-path measurement protocol (DESIGN.md §16): the ablation
+    pages identical windows through both paths and reports wall + MiB/s
+    + the ratio — the probe the driver's TPU column comes from. On CPU
+    devices only the protocol is under test, not the bandwidth."""
+    mesh = make_mesh(2)
+    cfg = dataclasses.replace(_headline(), stream_groups=True,
+                              cohort_blocks=1)
+    rep = stream_sched.staging_ablation(cfg, mesh, n_windows=2, repeats=1)
+    assert rep["n_devices"] == 2 and rep["windows"] == 2
+    assert rep["staged_wall_s"] > 0 and rep["naive_wall_s"] > 0
+    assert rep["staged_over_naive"] == pytest.approx(
+        rep["naive_wall_s"] / rep["staged_wall_s"], rel=1e-3)
+
+
+# ------------------------------------------------- engine differentials
+
+
+def test_sharded_streamed_fast_gate_with_telemetry(tmp_path):
+    """THE r17 fast gate: one window split over a 2-device mesh, two
+    launches per residency, interpret mode — bit-identical to the XLA
+    path on full State + Metrics — and the per-device telemetry rides
+    along: chunk spans on the sharded-streamed engine lane carry the
+    device count, the heartbeat JSONL grows one lane per device
+    (`...:c0:d0` / `...:c0:d1`), and stats splits the copy wall per
+    device."""
+    from raft_tpu.obs import (Heartbeat, Tracer, set_heartbeat,
+                              set_tracer, validate_trace)
+
+    nd = 2
+    mesh = make_mesh(nd)
+    scfg = dataclasses.replace(FAULTED, stream_groups=True,
+                               cohort_blocks=1)
+    st0 = state.init(FAULTED)
+    stx, mx = run(FAULTED, st0, 48, 0, metrics_init(64))
+    t = Tracer()
+    hb_path = tmp_path / "hb.jsonl"
+    prev_t = set_tracer(t)
+    prev_hb = set_heartbeat(Heartbeat(str(hb_path), every=1))
+    stats: dict = {}
+    try:
+        stp, mp = cohort.prun_streamed_sharded(
+            scfg, st0, 48, mesh, interpret=True, chunk_ticks=24,
+            stats=stats)
+    finally:
+        set_tracer(prev_t)
+        set_heartbeat(prev_hb)
+    ok, why = trees_equal_why(stx, stp)
+    assert ok, why
+    ok, why = trees_equal_why(mx, mp, names=list(type(mx)._fields))
+    assert ok, why
+    # 64 groups pad to nd*GB: one window of one block per device,
+    # chunk_ticks=24 over 48 ticks = two launches mid-residency.
+    assert stats["cohorts"] == 1 and stats["launches"] == 2
+    assert stats["n_devices"] == nd and stats["staging"] is True
+    assert [r["device"] for r in stats["per_device"]] \
+        == sorted(r["device"] for r in stats["per_device"])
+    assert len(stats["per_device"]) == nd
+    assert stats["slowest_device"] in [r["device"]
+                                       for r in stats["per_device"]]
+    for eff in stats["overlap_efficiency_per_device_measured"]:
+        assert 0.0 < eff <= 1.0
+    obj = t.to_json()
+    assert validate_trace(obj) == []
+    eng = cohort.sharded_engine(nd)
+    chunks = [e for e in obj["traceEvents"] if e["cat"] == "chunk"
+              and eng in e["name"]]
+    assert len(chunks) == 2
+    assert all(e["args"]["devices"] == nd for e in chunks)
+    recs = [json.loads(ln) for ln in hb_path.read_text().splitlines()]
+    lanes = {r["label"] for r in recs}
+    # 64 groups pad to 2 blocks: device 0 holds every live group, so
+    # ONLY its lane beats — a padding-only device must not invent one.
+    assert f"{eng}:c0:d0" in lanes
+    assert f"{eng}:c0:d1" not in lanes
+    by_lane = {r["label"]: r for r in recs}
+    assert by_lane[f"{eng}:c0:d0"]["engine"] == "pallas"
+    # Once live groups span both devices, both lanes beat — off a
+    # paged-in window directly (no kernel launch needed).
+    cfg2 = dataclasses.replace(FAULTED, n_groups=1500,
+                               stream_groups=True, cohort_blocks=1)
+    host2, g2 = cohort.host_wire(cfg2, state.init(cfg2),
+                                 pad_to=nd * pkernel.GB)
+    wins2 = cohort.cohort_windows(cfg2, host2, n_devices=nd)
+    win_leaves = stream_sched.put_window(host2, *wins2[0], mesh)
+    prev_hb = set_heartbeat(Heartbeat(str(tmp_path / "hb2.jsonl"),
+                                      every=1))
+    try:
+        cohort._heartbeat_sharded(eng, 0, 48, cfg2, win_leaves, g2,
+                                  *wins2[0])
+    finally:
+        set_heartbeat(prev_hb)
+    recs2 = [json.loads(ln)
+             for ln in (tmp_path / "hb2.jsonl").read_text().splitlines()]
+    assert {r["label"] for r in recs2} \
+        == {f"{eng}:c0:d0", f"{eng}:c0:d1"}
+
+
+@pytest.mark.slow
+def test_sharded_streamed_multi_window_three_way():
+    """THE r17 multi-cohort gate (slow tier: three interpret traces):
+    G=2500 pads to 4 blocks over 2 devices, cohort_blocks=2 pages two
+    windows of one block per device, chunk_ticks splits each residency
+    into two launches — and the sharded-streamed result is
+    bit-identical to the RESIDENT sharded kernel (State + Metrics +
+    flight ring) AND to the XLA path (State + Metrics)."""
+    from raft_tpu.obs import flight_init
+    from raft_tpu.parallel import kmesh
+
+    nd, g = 2, 2_500
+    mesh = make_mesh(nd)
+    cfg = dataclasses.replace(FAULTED, n_groups=g)
+    scfg = dataclasses.replace(cfg, stream_groups=True, cohort_blocks=2)
+    st0 = state.init(cfg)
+    stx, mx = run(cfg, st0, 24, 0, metrics_init(g))
+    stk, mk, flk = kmesh.prun_sharded(cfg, st0, 24, mesh, interpret=True,
+                                      flight=flight_init(g))
+    stats: dict = {}
+    sts, ms, fls = cohort.prun_streamed_sharded(
+        scfg, st0, 24, mesh, interpret=True, flight=flight_init(g),
+        chunk_ticks=12, stats=stats)
+    assert stats["cohorts"] == 2 and stats["launches"] == 4
+    assert stats["n_devices"] == nd
+    assert 0.0 < stats["overlap_efficiency_measured"] <= 1.0
+    for ref_st, ref_m, what in ((stx, mx, "vs-xla"),
+                                (stk, mk, "vs-resident-sharded")):
+        ok, why = trees_equal_why(ref_st, sts)
+        assert ok, (what, why)
+        ok, why = trees_equal_why(ref_m, ms, names=list(type(ms)._fields))
+        assert ok, (what, why)
+    ok, why = trees_equal_why(flk, fls)
+    assert ok, ("flight-ring", why)
+
+
+def test_stream_mesh_contracts_clean():
+    """The auditor's r17 additions hold on the clean tree: per-device
+    ceiling boundaries at 2 and 8 devices, whole-block slice coverage
+    through the public stream_sched seam, and the kleaf placement
+    rule."""
+    from raft_tpu.analysis import contracts
+
+    assert contracts.streaming_problems() == []
+
+
+# ------------------------------------------------------------ checkpoint
+
+
+def test_checkpoint_hops_residency_and_mesh_axes():
+    """Cross-(residency x mesh) coverage: a file saved by a 1-device
+    STREAMED run loads sharded onto an 8-device mesh under the
+    sharded-streamed knobs (and the loaded G admits 8-device paging
+    windows), and a file saved from an 8-device-sharded state loads
+    back under the 1-device resident cfg — both directions
+    bit-identical. Residency knobs never block the hop; a semantic
+    mismatch still refuses."""
+    from raft_tpu import parallel
+
+    cfg = FAULTED
+    scfg = dataclasses.replace(cfg, stream_groups=True, cohort_blocks=1)
+    mesh8 = make_mesh(8)
+    st = state.init(cfg)   # 64 groups: 8 blocks when padded to 8*GB
+    met = metrics_init(64)
+
+    # 1-dev streamed ckpt -> 8-dev sharded-streamed.
+    buf = io.BytesIO()
+    checkpoint.save(buf, st, 7, metrics=met, cfg=scfg)
+    buf.seek(0)
+    st2, t2, met2 = checkpoint.load(
+        buf, cfg=scfg, sharding=parallel.state_sharding(mesh8))
+    assert t2 == 7 and trees_equal(st, st2) and trees_equal(met, met2)
+    g = int(st2.alive_prev.shape[0])
+    assert pkernel.supported(scfg, n_groups=g, n_devices=8)
+    host, _ = cohort.host_wire(scfg, st2, pad_to=8 * pkernel.GB)
+    wins = cohort.cohort_windows(scfg, host, n_devices=8)
+    assert wins and all((s1 - s0) % (8 * pkernel.SUB) == 0
+                        for s0, s1 in wins)
+
+    # 8-dev sharded state -> 1-dev resident cfg.
+    st_sh = parallel.shard_state(st, mesh8)
+    buf = io.BytesIO()
+    checkpoint.save(buf, st_sh, 7, metrics=met, cfg=scfg)
+    buf.seek(0)
+    st3, t3, _ = checkpoint.load(buf, cfg=cfg)
+    assert t3 == 7 and trees_equal(st, st3)
+    # A SEMANTIC mismatch still refuses, mesh and residency aside.
+    buf.seek(0)
+    with pytest.raises(ValueError, match="cfg mismatch"):
+        checkpoint.load(buf, cfg=dataclasses.replace(scfg, seed=99))
+
+
+# ------------------------------------------------------------- manifests
+
+
+def test_stream_mesh_keys_present_from_birth_and_backfilled():
+    """r17 satellite: STREAM_MESH_KEYS ride every manifest record from
+    birth (null until stamped), history backfills them onto pre-r17
+    records, the emit-side and backfill-side registries are proven
+    equal, and the auditor names a side that forgot them — both
+    directions."""
+    from raft_tpu.analysis import contracts
+    from raft_tpu.obs import history
+    from raft_tpu.obs.manifest import STREAM_MESH_KEYS, emit_manifest
+
+    assert tuple(history.R17_MANIFEST_KEYS) == tuple(STREAM_MESH_KEYS)
+    rec = emit_manifest("probe", FAULTED, path="-")
+    for k in STREAM_MESH_KEYS:
+        assert k in rec and rec[k] is None
+    old = {k: v for k, v in rec.items() if k not in STREAM_MESH_KEYS}
+    back = history.backfill_record(old)
+    for k in STREAM_MESH_KEYS:
+        assert k in back and back[k] is None
+    assert contracts.manifest_problems() == []
+
+    class _NoMeshManifest:
+
+        @staticmethod
+        def emit_manifest(segment, cfg, device=None, path=None, **fields):
+            rec = emit_manifest(segment, cfg, device=device, path="-",
+                                **fields)
+            return {k: v for k, v in rec.items()
+                    if k not in STREAM_MESH_KEYS}
+
+    probs = contracts.manifest_problems(manifest_mod=_NoMeshManifest)
+    assert any("stream_devices" in p for p in probs)
+
+    class _NoMeshHistory:
+
+        @staticmethod
+        def backfill_record(rec):
+            out = history.backfill_record(rec)
+            for k in STREAM_MESH_KEYS:
+                out.pop(k, None)
+            return out   # forgot the r17 keys
+
+    probs = contracts.manifest_problems(history_mod=_NoMeshHistory)
+    assert any("stream_slowest_device" in p for p in probs)
+
+
+def test_stream_segment_fields_mesh_split_and_null_rule():
+    """The roofline producer stamps STREAM_KEYS + STREAM_MESH_KEYS
+    exactly: per-device predicted/measured splits and the slowest
+    device on streamed segments, null mesh keys on RESIDENT segments
+    (a resident run paged on zero devices — even a sharded one), and
+    the per-device predicted model agrees with overlap_efficiency."""
+    from raft_tpu.obs import roofline
+    from raft_tpu.obs.manifest import STREAM_KEYS, STREAM_MESH_KEYS
+
+    scfg = dataclasses.replace(_headline(), stream_groups=True)
+    on = roofline.stream_segment_fields(
+        scfg, measured=0.8125, chunk_ticks=200, n_devices=4,
+        per_device_measured=[1.0, 0.9, 1.0, 0.8], slowest_device=3)
+    assert set(on) == set(STREAM_KEYS) | set(STREAM_MESH_KEYS)
+    assert on["stream_devices"] == 4
+    assert on["stream_blocks_per_device"] == 1
+    assert on["overlap_efficiency_per_device_measured"] \
+        == [1.0, 0.9, 1.0, 0.8]
+    assert on["stream_slowest_device"] == 3
+    assert len(on["overlap_efficiency_per_device_predicted"]) == 4
+    for eff in on["overlap_efficiency_per_device_predicted"]:
+        assert 0.0 < eff <= 1.0
+    # Resident segment: the mesh keys must not claim paging devices,
+    # even when the kernel itself ran sharded over 8 chips.
+    off = roofline.stream_segment_fields(_headline(), n_devices=8)
+    assert off["stream_devices"] is None
+    assert off["stream_blocks_per_device"] is None
+    assert off["overlap_efficiency_per_device_predicted"] is None
+    assert off["overlap_efficiency_per_device_measured"] is None
+    assert off["stream_slowest_device"] is None
+    # The per-device prediction is the single-device window model
+    # evaluated on each device's equal slice: same value, N lanes.
+    ov = roofline.overlap_efficiency(scfg, chunk_ticks=200, n_devices=4)
+    assert ov["n_devices"] == 4
+    assert ov["window_groups"] \
+        == ov["window_groups_per_device"] * 4
+    assert ov["overlap_efficiency_per_device_predicted"] \
+        == [round(ov["overlap_efficiency_predicted"], 6)] * 4
+
+
+def test_sharded_engine_classification():
+    """`pallas-streamed-sharded-Ndev` strings classify as "pallas"
+    (prefix rule) so the history regression gate prices them with the
+    kernel byte model — and the fallback string a mismatch leaves
+    behind still classifies as the XLA engine that stood."""
+    from raft_tpu.obs.history import engine_class
+
+    assert cohort.sharded_engine(8) == "pallas-streamed-sharded-8dev"
+    assert engine_class("pallas-streamed-sharded-8dev") == "pallas"
+    assert engine_class(cohort.ENGINE) == "pallas"
+    assert engine_class("xla-scan (streamed mismatch!)") == "xla"

@@ -134,7 +134,7 @@ def test_overlap_efficiency_model_and_segment_fields():
     resident segments and computed on streamed ones, and a measured
     value passes through."""
     from raft_tpu.obs import roofline
-    from raft_tpu.obs.manifest import STREAM_KEYS
+    from raft_tpu.obs.manifest import STREAM_KEYS, STREAM_MESH_KEYS
 
     scfg = dataclasses.replace(_headline(), stream_groups=True)
     pred = roofline.overlap_efficiency(scfg, chunk_ticks=200)
@@ -146,7 +146,10 @@ def test_overlap_efficiency_model_and_segment_fields():
     assert longer["overlap_efficiency_predicted"] \
         >= pred["overlap_efficiency_predicted"]
     off = roofline.stream_segment_fields(_headline())
-    assert set(off) == set(STREAM_KEYS)
+    # r17 grew the stamp: the producer now carries the mesh keys too
+    # (null on resident segments — tests/test_stream_mesh.py pins the
+    # split and the null rule).
+    assert set(off) == set(STREAM_KEYS) | set(STREAM_MESH_KEYS)
     assert off["stream_groups"] is False
     assert off["overlap_efficiency_predicted"] is None
     assert off["overlap_efficiency_measured"] is None
