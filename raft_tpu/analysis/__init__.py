@@ -37,12 +37,18 @@ def audit_report(level: str = "full") -> dict:
     `level="static"` skips the behavioral checkpoint round-trips (the
     only pass that materializes concrete host arrays) — the cheap
     import-time form bench/kernel_sweep gate their startup on;
-    `level="full"` is the CI/script form.
+    `level="full"` is the CI/script form; `level="deep"` (r18) is full
+    plus the verification passes — a depth-limited model-checker smoke
+    (exhaustive clean oracle at tiny scope + a seeded-mutant canary
+    kill, verify/mcheck.py) and the scheduler hazard prover over its
+    whole bound grid plus its synthetic negatives (verify/hazards.py).
+    Deep stays chip-free and fits the pre-push gate
+    (scripts/ci_static.sh).
     """
-    if level not in ("static", "full"):
+    if level not in ("static", "full", "deep"):
         raise ValueError(f"unknown audit level {level!r}")
     problems = contracts.contract_problems(
-        include_behavioral=(level == "full"))
+        include_behavioral=(level in ("full", "deep")))
     # One derivation per (config, flight) point — the flight-on models
     # double as the report's byte_model block (each derivation is
     # several eval_shape traces; don't pay them twice per startup).
@@ -59,13 +65,37 @@ def audit_report(level: str = "full") -> dict:
             if wf:
                 byte_models[label] = model
     findings = lint.lint_default()
-    return {
+    verify_block = None
+    if level == "deep":
+        from raft_tpu.verify import hazards, mcheck
+        smoke = mcheck.smoke()
+        if not (smoke.ok and smoke.complete):
+            problems.append(
+                "mcheck smoke: clean oracle not exhaustively verified "
+                f"at smoke scope ({smoke.summary()})")
+        haz = hazards.prove_schedulers()
+        problems += [f"scheduler hazard: {h}" for h in haz["hazards"]]
+        neg = hazards.prove_negatives()
+        if neg["missed"]:
+            problems.append(
+                "hazard prover failed to catch synthetic negatives: "
+                + ", ".join(neg["missed"]))
+        verify_block = {
+            "mcheck_smoke": smoke.summary(),
+            "hazard_configs": haz["configs"],
+            "hazard_events": haz["events"],
+            "negatives_caught": neg["caught"],
+        }
+    report = {
         "level": level,
         "ok": not problems and not findings,
         "problems": problems,
         "lint": [f.as_dict() for f in findings],
         "byte_model": byte_models,
     }
+    if verify_block is not None:
+        report["verify"] = verify_block
+    return report
 
 
 def audit_problems(level: str = "full") -> list[str]:

@@ -28,9 +28,15 @@ def main(argv=None) -> int:
                     "(DESIGN.md §11). rc != 0 on any drift.")
     ap.add_argument("--json", action="store_true",
                     help="print the full machine-readable report")
-    ap.add_argument("--level", choices=("static", "full"), default="full",
+    ap.add_argument("--level", choices=("static", "full", "deep"),
+                    default="full",
                     help="'static' skips the behavioral checkpoint "
-                         "round-trips (the bench startup form)")
+                         "round-trips (the bench startup form); 'deep' "
+                         "adds the r18 verification passes — model-"
+                         "checker smoke + scheduler hazard prover "
+                         "(still chip-free, fits the pre-push gate)")
+    ap.add_argument("--deep", action="store_true",
+                    help="alias for --level deep")
     ap.add_argument("--bytes", action="store_true",
                     help="also print the per-leaf derived byte table")
     ap.add_argument("--inject-drift", metavar="LEAF", default=None,
@@ -38,6 +44,8 @@ def main(argv=None) -> int:
                          "grew this fake leaf (must exit nonzero naming "
                          "it)")
     args = ap.parse_args(argv)
+    if args.deep:
+        args.level = "deep"
 
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -111,6 +119,13 @@ def main(argv=None) -> int:
                   f"+ checkpoint coverage + byte model (headline {hb} "
                   f"B/group, clients {cb} B/group, derived == pinned) + "
                   f"purity lint all clean")
+            if "verify" in report:
+                v = report["verify"]
+                print(f"verification ok (deep): mcheck smoke "
+                      f"[{v['mcheck_smoke']}] + hazard prover "
+                      f"({v['hazard_configs']} scheduler configs, "
+                      f"{v['hazard_events']} events, 0 hazards, "
+                      f"{v['negatives_caught']}/3 negatives caught)")
     return 0 if report["ok"] else 1
 
 

@@ -46,11 +46,17 @@ from typing import Iterable
 # schedule diverge from the resident kernel it must stay bit-identical
 # to. r17 adds the shard-aware scheduler (parallel/stream_sched.py):
 # per-device slicing and staging decisions are schedule, so the same
-# shapes-and-knobs-only rule applies.
+# shapes-and-knobs-only rule applies. r18 closes the remaining gap in
+# the multi-device surface: parallel/kmesh.py (the shard_map launch
+# wrapper — sharding and resharding decisions must be shape/knob
+# static) and ops/quorum.py (popcount/majority lane math used by every
+# engine's vote and commit paths — a hidden draw or traced branch
+# there skews all three engines at once).
 DEFAULT_TARGETS = ("sim/step.py", "sim/pkernel.py", "clients/workload.py",
                    "utils/jrng.py", "nemesis/program.py",
                    "nemesis/search.py", "parallel/cohort.py",
-                   "parallel/stream_sched.py")
+                   "parallel/stream_sched.py", "parallel/kmesh.py",
+                   "ops/quorum.py")
 
 # The jrng functions the elementwise rule covers (the compiled nemesis
 # evaluators — DESIGN.md §14; the rest of jrng predates the rule and is

@@ -202,16 +202,23 @@ def mutate(prog: tuple, horizon: int, seed: int, step: int) -> tuple:
 
 def search(base_cfg: RaftConfig, n_groups: int, n_ticks: int,
            budget: int, seed: int = 0, start: tuple | None = None,
-           log=None) -> dict:
+           log=None, seed_corpus: list | None = None) -> dict:
     """The coverage-guided loop: `budget` mutate-run-score steps from a
     seed corpus. Returns {corpus, coverage, best, best_score,
     violations} — `violations` are (program, signals) pairs whose runs
     dropped the per-tick safety bit (shrink them with `shrink`).
     Deterministic in (base_cfg, n_groups, n_ticks, budget, seed,
-    start). NOTE each distinct program is a distinct static config: a
-    step costs one XLA compile of the tick program — size the shapes
-    like a test, not like a bench."""
-    corpus = [start if start is not None else gray_mix(n_ticks)]
+    start, seed_corpus). NOTE each distinct program is a distinct
+    static config: a step costs one XLA compile of the tick program —
+    size the shapes like a test, not like a bench.
+
+    `seed_corpus`: programs from a PERSISTED corpus (`load_corpus`) to
+    seed the mutation pool — a resumed hunt starts from every
+    coverage-novel program earlier hunts found instead of the canonical
+    gray mix. Seeded programs are mutation parents only (not re-run, so
+    resuming costs no extra compiles until mutation reaches them)."""
+    corpus = (list(seed_corpus) if seed_corpus
+              else [start if start is not None else gray_mix(n_ticks)])
     coverage: dict = {}
     violations: list = []
     best, best_score = corpus[0], float("-inf")
@@ -410,6 +417,50 @@ def shrink(prog: tuple, repro, log=None):
             if changed:
                 break
     return prog, report
+
+
+# ---------------------------------------------------- corpus persistence
+
+
+def save_corpus(dirpath: str, corpus) -> int:
+    """Persist a search corpus (r18: `--corpus DIR`): one JSON file per
+    coverage-novel program, named by program hash — idempotent across
+    runs (re-saving a program overwrites identical bytes), so repeated
+    hunts into the same DIR accumulate coverage monotonically."""
+    import os
+    os.makedirs(dirpath, exist_ok=True)
+    for prog in corpus:
+        h = program_hash(prog)
+        with open(os.path.join(dirpath, f"corpus_{h}.json"), "w") as fh:
+            json.dump({"schema": ARTIFACT_SCHEMA,
+                       "kind": "nemesis-corpus-entry",
+                       "program": to_json(prog),
+                       "program_hash": h}, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+    return len(corpus)
+
+
+def load_corpus(dirpath: str) -> list:
+    """Reload a persisted corpus (sorted by filename, so the seeded
+    mutation pool is deterministic); [] when DIR is absent or holds no
+    entries. Entries failing the hash self-check are skipped loudly
+    rather than poisoning a deterministic hunt."""
+    import glob
+    import os
+    import sys
+    progs = []
+    for path in sorted(glob.glob(os.path.join(dirpath, "corpus_*.json"))):
+        with open(path) as fh:
+            entry = json.load(fh)
+        if entry.get("kind") != "nemesis-corpus-entry":
+            continue
+        prog = from_json(entry["program"])
+        if program_hash(prog) != entry.get("program_hash"):
+            print(f"[corpus] {path}: hash mismatch, skipping",
+                  file=sys.stderr)
+            continue
+        progs.append(prog)
+    return progs
 
 
 # ----------------------------------------------------------- artifacts

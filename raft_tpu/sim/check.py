@@ -12,54 +12,53 @@ Used two ways (DESIGN.md §8):
   a violation that exists for a single tick between check boundaries
   can no longer hide.
 
-The differential suite remains the strong correctness gate; these
-predicates are the cheap always-on safety net.
+The predicate BODIES live in `verify/invariants.py` (r18): one
+invariant source shared with the bounded model checker, evaluated here
+with `xp=jnp` over State leaves — the runtime fold is a spot-check of
+the exact predicates `verify.mcheck` proves exhaustively at small
+scope. The differential suite remains the strong correctness gate;
+these are the cheap always-on safety net.
 """
 
 from __future__ import annotations
 
-import itertools
-
 import jax.numpy as jnp
 
-from raft_tpu.core.node import LEADER
 from raft_tpu.sim.state import State
+from raft_tpu.verify import invariants as inv
 
 
 def election_safety(st: State):
     """bool[G]: no two current leaders share a term (point-in-time form of
     cluster._check_election_safety; crashed leaders still hold their term)."""
-    nodes = st.nodes
-    k = nodes.term.shape[1]
-    ok = jnp.ones(nodes.term.shape[0], jnp.bool_)
-    for a, b in itertools.combinations(range(k), 2):
-        clash = ((nodes.role[:, a] == LEADER) & (nodes.role[:, b] == LEADER)
-                 & (nodes.term[:, a] == nodes.term[:, b]))
-        ok &= ~clash
-    return ok
+    return inv.election_safety(st.nodes.role, st.nodes.term, xp=jnp)
 
 
 def digest_agreement(st: State):
     """bool[G]: nodes that applied the same prefix hold the same state-
     machine digest (commit-identity, cluster._on_apply's invariant)."""
-    nodes = st.nodes
-    k = nodes.term.shape[1]
-    ok = jnp.ones(nodes.term.shape[0], jnp.bool_)
-    for a, b in itertools.combinations(range(k), 2):
-        clash = ((nodes.applied[:, a] == nodes.applied[:, b])
-                 & (nodes.digest[:, a] != nodes.digest[:, b]))
-        ok &= ~clash
-    return ok
+    return inv.digest_agreement(st.nodes.applied, st.nodes.digest, xp=jnp)
 
 
 def window_bounds(st: State, log_cap: int):
     """bool[G]: per-node structural sanity — applied == commit (phase A
     drains), snap <= commit <= last, window within the ring capacity."""
     n = st.nodes
-    ok = ((n.applied == n.commit)
-          & (n.snap_index <= n.commit) & (n.commit <= n.last_index)
-          & (n.last_index - n.snap_index <= log_cap))
-    return jnp.all(ok, axis=1)
+    return inv.window_bounds(n.applied, n.commit, n.snap_index,
+                             n.last_index, log_cap, xp=jnp)
+
+
+def leader_completeness(st: State, log_cap: int):
+    """bool[G]: a current leader's log covers every node's committed
+    prefix — commit_b <= last_index_a plus per-ring-lane payload
+    agreement on the committed overlap, for every ordered pair with
+    role_a == LEADER and term_a >= term_b (the r18 clause; soundness
+    argument in verify/invariants.py). Payload-based, so takeover's
+    in-place re-term never trips it."""
+    n = st.nodes
+    return inv.leader_completeness(n.role, n.term, n.commit, n.last_index,
+                                   n.snap_index, n.log_payload, log_cap,
+                                   xp=jnp)
 
 
 def client_safety(st: State):
@@ -83,16 +82,8 @@ def client_safety(st: State):
     a current table witness" is not crash-stable — the ack-time
     witness requirement lives in the client transition itself and in
     the oracle differential, tests/test_clients.py.)"""
-    nodes = st.nodes
-    cl = st.clients
-    k = nodes.term.shape[1]
-    table = nodes.session_seq                       # [G, K, S]
-    ok = jnp.all(table <= cl.done[:, None, :], axis=(1, 2))
-    for a, b in itertools.combinations(range(k), 2):
-        clash = ((nodes.applied[:, a] == nodes.applied[:, b])
-                 & jnp.any(table[:, a] != table[:, b], axis=-1))
-        ok &= ~clash
-    return ok
+    return inv.client_safety(st.nodes.applied, st.nodes.session_seq,
+                             st.clients.done, xp=jnp)
 
 
 def predicate_report(st: State, log_cap: int) -> dict:
@@ -100,7 +91,8 @@ def predicate_report(st: State, log_cap: int) -> dict:
     nemesis search (raft_tpu/nemesis/search.py) scores near-misses per
     predicate and its safety-violation triage names WHICH invariant a
     state breaks, not just that one did. Key order is stable (report/
-    artifact fields). THE clause registry: `all_invariants` (and hence
+    artifact fields; new keys append so pre-r18 artifacts' leaf names
+    stay valid). THE clause registry: `all_invariants` (and hence
     `tick_safety`) is its AND-reduce, so a predicate added here is
     automatically folded and nameable — they cannot drift."""
     out = {"election_safety": election_safety(st),
@@ -108,6 +100,7 @@ def predicate_report(st: State, log_cap: int) -> dict:
            "window_bounds": window_bounds(st, log_cap)}
     if st.clients is not None:
         out["client_safety"] = client_safety(st)
+    out["leader_completeness"] = leader_completeness(st, log_cap)
     return out
 
 
@@ -121,10 +114,13 @@ def all_invariants(st: State, log_cap: int):
 def tick_safety(st: State, log_cap: int):
     """bool[G]: the per-tick safety predicate ANDed into
     `Metrics.safety` on both engines — election safety, digest
-    agreement, window bounds, and (with scheduled clients on) the
-    exactly-once invariant. A named alias of `all_invariants` so the
-    fold's contract ("what exactly does the safety bit attest?") has
-    one definition site; pkernel's `_safety_tick` must mirror any
-    change here term-for-term (pinned by the kernel differentials and
-    scripts/check_metric_parity.py's field parity)."""
+    agreement, window bounds, leader completeness, and (with scheduled
+    clients on) the exactly-once invariant. A named alias of
+    `all_invariants` so the fold's contract ("what exactly does the
+    safety bit attest?") has one definition site; pkernel's
+    `_safety_tick` must mirror any change here term-for-term (pinned by
+    the kernel differentials and scripts/check_metric_parity.py's field
+    parity). Pre-r18 checkpoints resume cleanly under the stronger
+    fold: `safety` is an AND accumulator, so a resumed run simply
+    starts attesting the new clause from its first resumed tick."""
     return all_invariants(st, log_cap)

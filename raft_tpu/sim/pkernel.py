@@ -1502,11 +1502,15 @@ class KMetrics(NamedTuple):
 def _safety_tick(cfg, nodes, cl=None):
     """check.tick_safety on k-state tiles, one [8, 128] bit per group:
     election safety (pairwise leader term compare), digest agreement on
-    equal applied prefixes, per-node window bounds, and (clients on)
-    the exactly-once invariant (check.client_safety: pairwise dedup-
-    table agreement + no table seq above the issued frontier) —
-    term-for-term the predicates in sim/check.py, statically unrolled
-    over K (and K^2/2 pairs) like every other kernel reduction."""
+    equal applied prefixes, per-node window bounds, leader completeness
+    (r18: each leader's log covers every node's committed prefix —
+    commit_b <= last_index_a plus payload agreement on the committed
+    ring overlap, over ordered pairs with term_a >= term_b), and
+    (clients on) the exactly-once invariant (check.client_safety:
+    pairwise dedup-table agreement + no table seq above the issued
+    frontier) — term-for-term the predicates in verify/invariants.py
+    via sim/check.py, statically unrolled over K (and K^2 pairs) like
+    every other kernel reduction."""
     ok = None
     for j in range(cfg.k):
         wb = ((nodes.applied[j] == nodes.commit[j])
@@ -1514,6 +1518,13 @@ def _safety_tick(cfg, nodes, cl=None):
               & (nodes.commit[j] <= nodes.last_index[j])
               & (nodes.last_index[j] - nodes.snap_index[j] <= cfg.log_cap))
         ok = wb if ok is None else ok & wb
+    # Per-node ring slot -> absolute index ([L, 8, 128] each), hoisted
+    # out of the pair loops: invariants.slot_abs_index == _abs_index.
+    absidx = []
+    for j in range(cfg.k):
+        off = _col(cfg.log_cap) - nodes.snap_index[j] % cfg.log_cap
+        absidx.append(nodes.snap_index[j] + 1
+                      + jnp.where(off >= 0, off, off + cfg.log_cap))
     for a in range(cfg.k):
         for b in range(a + 1, cfg.k):
             clash = ((nodes.role[a] == LEADER) & (nodes.role[b] == LEADER)
@@ -1521,6 +1532,18 @@ def _safety_tick(cfg, nodes, cl=None):
             split = ((nodes.applied[a] == nodes.applied[b])
                      & (nodes.digest[a] != nodes.digest[b]))
             ok = ok & ~clash & ~split
+    for a in range(cfg.k):
+        for b in range(cfg.k):
+            if a == b:
+                continue
+            cond = ((nodes.role[a] == LEADER)
+                    & (nodes.term[a] >= nodes.term[b]))
+            lim = jnp.minimum(nodes.commit[b], nodes.last_index[a])
+            m = (absidx[a] == absidx[b]) & (absidx[a] <= lim)
+            bad = ((nodes.commit[b] > nodes.last_index[a])
+                   | jnp.any(m & (nodes.log_payload[a]
+                                  != nodes.log_payload[b]), axis=0))
+            ok = ok & ~(cond & bad)
     if cl is not None:
         table = nodes.session_seq                     # [K, S, 8, 128]
         for j in range(cfg.k):

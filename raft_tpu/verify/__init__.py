@@ -1,0 +1,24 @@
+"""Exhaustive verification without a chip (DESIGN.md §17).
+
+Three instruments over the SAME semantics the engines execute:
+
+- `invariants`: the one source of the safety predicates — array-level,
+  generic over numpy/jax.numpy. `sim/check.py`'s per-tick fold and the
+  bounded model checker both evaluate these exact functions, so the
+  runtime safety bit is by construction a spot-check of what the
+  checker proves exhaustively at small scope.
+- `mcheck`: bounded exhaustive model checker — BFS over canonicalized
+  states of the REAL CPU oracle (`core/node.py`) under all delivery /
+  drop / crash / timeout schedules within bounds, with node-permutation
+  symmetry reduction; counterexamples emit as nemesis-format
+  reproducer artifacts that replay through `scripts/nemesis_search.py`.
+- `hazards`: static happens-before prover for the r16/r17 streaming
+  pipeline — records the put/launch/drain/staging event order the real
+  scheduler code dispatches (patched copy/launch seams, no chip) and
+  proves the ordering invariants over a (cohort_blocks, n_devices, G)
+  grid.
+
+`mutants` seeds ~12 semantic bugs into the oracle step; the checker
+must kill every one (tests/test_verify.py's kill matrix) — the proof
+the verifier has teeth.
+"""

@@ -1,0 +1,450 @@
+"""Static happens-before hazard prover for the cohort paging pipeline
+(DESIGN.md §17): prove `parallel/cohort.py` + `parallel/stream_sched.py`
+schedule their put / launch / drain / staging-reuse operations safely —
+for every (cohort_blocks, n_devices, n_windows) within bounds — without
+running a chip.
+
+How it works: the pipeline's device-touching primitives are narrow and
+module-seamed (`cohort._window`, `stream_sched.put_window`,
+`pkernel.kstep`, `kmesh.kstep_sharded`, `cohort._writeback`,
+`stream_sched.drain_window`, `jax.block_until_ready`). `capture()`
+monkeypatches that seam table with recording stubs and runs the REAL
+scheduler loop (`cohort.stream_ticks` / `stream_ticks_sharded`) over
+synthetic host leaves — the control flow under audit is the shipped
+code, byte for byte; only the copies and launches are replaced by event
+emission. The result is a total-order event trace in program order,
+each event stamped with the scheduler call site (`file.py:line`).
+
+`check_trace` then replays the trace against the dependency rules the
+module docstrings promise (stream_sched.py "Slot-reuse safety",
+cohort.py's pipeline contract):
+
+- **drain-before-sync** — a window's d2h drain must happen-after
+  completion evidence for its launches (`block_until_ready`). The real
+  np.asarray would block anyway, but THAT is the engine saving the
+  scheduler; the contract is that the pipeline never *relies* on it —
+  a drain of an in-flight window serializes d2h behind compute on the
+  device queue and voids the overlap model (DESIGN.md §15/§16).
+- **staging-overwrite-in-flight** — a StagingPool slot may be
+  overwritten only after the window previously staged there has
+  completion evidence (its `device_put`s are long returned by then —
+  the depth-2 reuse argument, stream_sched.py:37-43).
+- **double-drain** — each resident window drains exactly once (a
+  second drain would overwrite host rows a later window already
+  evolved).
+- **drain-coverage** — per pass over the store, the drained [s0, s1)
+  ranges must tile [0, GS) exactly: every wire offset written exactly
+  once per pass, no gap, no overlap. Put ranges must tile identically
+  (nothing computed but never persisted, nothing persisted twice).
+
+A violated rule is reported as a `Hazard` naming the rule, the window,
+and the scheduler source line that issued the offending operation —
+`prove_schedulers()` must return zero hazards over the whole bound grid
+(the r16/r17 pipelines), and the synthetic negative schedulers below
+(`synthetic_use_after_free`, `synthetic_double_drain`,
+`synthetic_slot_overwrite`) must each be caught with their own
+file:line. Wired into `scripts/static_audit.py --level deep`.
+
+Soundness/limits: the proof is over the scheduler's *program order* at
+one (cohort_blocks, n_devices, n_windows) point per run — a data-
+dependent schedule would need per-point re-proof, which is what the
+grid sweep is. Python program order is the happens-before order here
+(single host thread issues every operation; device-side reordering is
+exactly what the completion-evidence rules guard). `device_put`'s
+return is NOT taken as copy-completion evidence — only
+`block_until_ready` is — so the rules are conservative with respect to
+a fully async transfer engine."""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import itertools
+import traceback
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.config import RaftConfig
+from raft_tpu.sim.pkernel import GB, LANE, SUB
+
+_THIS_FILE = __file__
+
+
+# ------------------------------------------------------------- the trace
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One pipeline operation, in issue (program) order."""
+    kind: str                      # put | stage | launch | sync | drain
+    token: int                     # resident-window instance id
+    win: Tuple[int, int]           # (s0, s1) sublane range in the store
+    slot: Optional[int]            # staging slot (stage events only)
+    site: str                      # "file.py:NN" — the scheduler line
+
+
+@dataclasses.dataclass(frozen=True)
+class Hazard:
+    """One dependency-rule violation, named with the scheduler source
+    line that issued the unsafe operation."""
+    rule: str
+    site: str
+    detail: str
+
+    def __str__(self):
+        return f"{self.rule} at {self.site}: {self.detail}"
+
+
+class _Tok:
+    """Opaque stand-in for a resident device window (the tuple of
+    sharded arrays in the real pipeline). The scheduler only threads it
+    through kstep/block_until_ready/writeback, so an attribute bag is
+    enough."""
+    _next = itertools.count()
+
+    def __init__(self, win):
+        self.tid = next(_Tok._next)
+        self.win = win
+
+
+def _site() -> str:
+    """file.py:line of the innermost non-stub caller — the scheduler
+    statement that issued the operation under capture."""
+    for fr in reversed(traceback.extract_stack()):
+        if fr.filename == _THIS_FILE and (
+                fr.name.startswith("_stub") or fr.name in
+                ("_site", "stage")):
+            continue
+        base = fr.filename.rsplit("/", 1)[-1]
+        return f"{base}:{fr.lineno}"
+    return "<unknown>"
+
+
+# -------------------------------------------------------------- capture
+
+
+@contextlib.contextmanager
+def capture(events: List[Event]):
+    """Patch the pipeline's device-seam table with recording stubs;
+    restore on exit. Inside the context, running any scheduler built on
+    the seams (the real `cohort.stream_ticks`/`stream_ticks_sharded`,
+    or the synthetic negatives below) appends its operation trace to
+    `events` without touching a device."""
+    import jax
+
+    from raft_tpu.parallel import cohort, stream_sched
+    from raft_tpu.sim import pkernel
+
+    def _stub_window(host_leaves, s0, s1):
+        t = _Tok((s0, s1))
+        events.append(Event("put", t.tid, (s0, s1), None, _site()))
+        return t
+
+    def _stub_put_window(host_leaves, s0, s1, mesh, pool=None, slot=0,
+                         per_device=None):
+        t = _Tok((s0, s1))
+        if pool is not None:
+            # The staged path copies into the parity slot BEFORE the
+            # device_puts read it — keep the real copy (it validates
+            # shapes) and record the reuse event.
+            pool.stage(host_leaves, s0, s1, slot)
+            events.append(Event("stage", t.tid, (s0, s1),
+                                slot % stream_sched.StagingPool.SLOTS,
+                                _site()))
+        events.append(Event("put", t.tid, (s0, s1), None, _site()))
+        return t
+
+    def _stub_kstep(cfg, leaves, t0, n_ticks, interpret=False, **kw):
+        events.append(Event("launch", leaves.tid, leaves.win, None,
+                            _site()))
+        return leaves
+
+    def _stub_kstep_sharded(cfg, leaves, t0, n_ticks, mesh,
+                            interpret=False, **kw):
+        events.append(Event("launch", leaves.tid, leaves.win, None,
+                            _site()))
+        return leaves
+
+    def _stub_block(x, *a, **kw):
+        if isinstance(x, _Tok):
+            events.append(Event("sync", x.tid, x.win, None, _site()))
+            return x
+        return _real_block(x, *a, **kw)
+
+    def _stub_writeback(host_leaves, window_leaves, s0, s1):
+        events.append(Event("drain", window_leaves.tid, (s0, s1), None,
+                            _site()))
+
+    def _stub_drain_window(host_leaves, window_leaves, s0, s1,
+                           per_device=None):
+        events.append(Event("drain", window_leaves.tid, (s0, s1), None,
+                            _site()))
+
+    try:
+        from raft_tpu.parallel import kmesh
+    except Exception:                             # pragma: no cover
+        kmesh = None
+    saved = [(cohort, "_window", cohort._window),
+             (cohort, "_writeback", cohort._writeback),
+             (stream_sched, "put_window", stream_sched.put_window),
+             (stream_sched, "drain_window", stream_sched.drain_window),
+             (pkernel, "kstep", pkernel.kstep),
+             (jax, "block_until_ready", jax.block_until_ready)]
+    if kmesh is not None:
+        saved.append((kmesh, "kstep_sharded", kmesh.kstep_sharded))
+    _real_block = jax.block_until_ready
+    cohort._window = _stub_window
+    cohort._writeback = _stub_writeback
+    stream_sched.put_window = _stub_put_window
+    stream_sched.drain_window = _stub_drain_window
+    pkernel.kstep = _stub_kstep
+    jax.block_until_ready = _stub_block
+    if kmesh is not None:
+        kmesh.kstep_sharded = _stub_kstep_sharded
+    try:
+        yield events
+    finally:
+        for mod, name, fn in saved:
+            setattr(mod, name, fn)
+
+
+class _FakeMesh:
+    """mesh.size is all the captured scheduler needs (put/drain/launch
+    are stubbed; _heartbeat_sharded no-ops without a heartbeat)."""
+
+    def __init__(self, size):
+        self.size = size
+
+
+def _fake_leaves(gs: int, n_leaves: int = 2):
+    """Tiny host-store stand-ins: real numpy arrays (StagingPool's real
+    allocation + copy run against them) with `gs` sublanes of `LANE`
+    lanes — the only geometry the scheduler reads."""
+    return [np.zeros((gs, LANE), dtype=np.uint32)
+            for _ in range(n_leaves)]
+
+
+# ----------------------------------------------------------- the prover
+
+
+def check_trace(events: List[Event], gs: int,
+                passes: int = 1) -> List[Hazard]:
+    """Replay an event trace against the dependency rules; returns the
+    hazards found (empty == proven safe for this schedule). `gs` is the
+    store's sublane extent; `passes` how many full store sweeps the
+    trace is expected to make (stream_ticks makes one per call)."""
+    hazards = []
+    synced: set = set()
+    drained_tokens: set = set()
+    slot_owner: dict = {}
+    put_ranges: List[Tuple[int, int]] = []
+    drain_ranges: List[Tuple[int, int]] = []
+    for ev in events:
+        if ev.kind == "stage":
+            prev = slot_owner.get(ev.slot)
+            if prev is not None and prev not in synced:
+                hazards.append(Hazard(
+                    "staging-overwrite-in-flight", ev.site,
+                    f"slot {ev.slot} restaged for window {ev.win} while "
+                    f"the window previously staged there has no "
+                    f"completion evidence"))
+            slot_owner[ev.slot] = ev.token
+        elif ev.kind == "put":
+            put_ranges.append(ev.win)
+        elif ev.kind == "sync":
+            synced.add(ev.token)
+        elif ev.kind == "drain":
+            if ev.token in drained_tokens:
+                hazards.append(Hazard(
+                    "double-drain", ev.site,
+                    f"window {ev.win} drained twice"))
+            drained_tokens.add(ev.token)
+            if ev.token not in synced:
+                hazards.append(Hazard(
+                    "drain-before-sync", ev.site,
+                    f"window {ev.win} drained without completion "
+                    f"evidence for its launches"))
+            drain_ranges.append(ev.win)
+    for label, ranges in (("put", put_ranges), ("drain", drain_ranges)):
+        cover = sorted(ranges)
+        expect = passes * _tile(gs, cover)
+        if cover != sorted(expect):
+            hazards.append(Hazard(
+                "drain-coverage", "<whole-trace>",
+                f"{label} ranges {cover} do not tile [0, {gs}) exactly "
+                f"{passes}x"))
+    return hazards
+
+
+def _tile(gs: int, cover: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
+    """The expected one-pass tiling of [0, gs): infer the window step
+    from the trace's first range (bounds geometry), fall back to one
+    whole-store window."""
+    step = (cover[0][1] - cover[0][0]) if cover else gs
+    step = step or gs
+    return [(s0, min(s0 + step, gs)) for s0 in range(0, gs, step)]
+
+
+# ------------------------------------------------- real-scheduler proofs
+
+
+def _run_real(cfg: RaftConfig, gs: int, n_devices: int,
+              staging: bool = True, n_ticks: int = 2,
+              chunk_ticks: int = 1) -> List[Event]:
+    """Run the SHIPPED pipeline loop over a synthetic store under
+    capture; returns its event trace."""
+    from raft_tpu.parallel import cohort
+    leaves = _fake_leaves(gs)
+    events: List[Event] = []
+    with capture(events):
+        if n_devices == 1:
+            cohort.stream_ticks(cfg, leaves, gs * LANE, 0, n_ticks,
+                                chunk_ticks=chunk_ticks)
+        else:
+            cohort.stream_ticks_sharded(
+                cfg, leaves, gs * LANE, 0, n_ticks, _FakeMesh(n_devices),
+                chunk_ticks=chunk_ticks, staging=staging)
+    return events
+
+
+def prove_schedulers(max_cohort_blocks: int = 3, max_devices: int = 4,
+                     max_windows: int = 4,
+                     log: Callable = None) -> dict:
+    """The r18 hazard proof: for every (cohort_blocks, n_devices,
+    n_windows, staging) within bounds, run the real r16 unsharded and
+    r17 sharded pipeline loops under capture and check every trace.
+    Returns {"configs": n, "events": n, "hazards": [str, ...]} —
+    hazards must be empty; static_audit --level deep asserts so."""
+    from raft_tpu.sim import pkernel as pk
+
+    n_cfg = n_ev = 0
+    hazards: List[Hazard] = []
+    for cb, nd in itertools.product(range(1, max_cohort_blocks + 1),
+                                    (1, 2, max_devices)):
+        cfg = RaftConfig(seed=0, k=3, stream_groups=True,
+                         cohort_blocks=cb)
+        step = pk.stream_blocks_per_device(cfg, nd) * nd * SUB
+        for nw in range(1, max_windows + 1):
+            gs = step * nw
+            for staging in ((True, False) if nd > 1 else (True,)):
+                ev = _run_real(cfg, gs, nd, staging=staging)
+                n_cfg += 1
+                n_ev += len(ev)
+                found = check_trace(ev, gs)
+                hazards += found
+                if log and found:
+                    log(f"hazards at cb={cb} nd={nd} nw={nw} "
+                        f"staging={staging}: {[str(h) for h in found]}")
+    return {"configs": n_cfg, "events": n_ev,
+            "hazards": [str(h) for h in hazards]}
+
+
+# ------------------------------------------- synthetic negative fixtures
+#
+# Buggy scheduler loops written against the SAME seams, so the prover's
+# detection (and its file:line naming) is itself tested — each of these
+# must be caught, at a line inside this file. They mirror the shape of
+# cohort.stream_ticks with one dependency edge removed.
+
+
+def synthetic_use_after_free(cfg: RaftConfig, gs: int) -> List[Event]:
+    """BUG: drains window i right after its launches DISPATCH — before
+    any completion evidence — modeling a d2h racing the compute that
+    still owns the buffers."""
+    from raft_tpu.parallel import cohort
+    from raft_tpu.sim import pkernel
+    leaves = _fake_leaves(gs)
+    events: List[Event] = []
+    step = pkernel.stream_blocks_per_device(cfg, 1) * SUB
+    wins = [(s0, min(s0 + step, gs)) for s0 in range(0, gs, step)]
+    with capture(events):
+        for s0, s1 in wins:
+            cur = cohort._window(leaves, s0, s1)
+            cur = pkernel.kstep(cfg, cur, 0, 1)
+            cohort._writeback(leaves, cur, s0, s1)   # <- no sync first
+    return events
+
+
+def synthetic_double_drain(cfg: RaftConfig, gs: int) -> List[Event]:
+    """BUG: drains the final window twice (a stale `pending` not
+    cleared after the epilogue drain) — the second drain overwrites
+    host rows with the same bytes today, and with ANOTHER window's
+    evolution the day the loop is reordered."""
+    import jax
+
+    from raft_tpu.parallel import cohort
+    from raft_tpu.sim import pkernel
+    leaves = _fake_leaves(gs)
+    events: List[Event] = []
+    with capture(events):
+        cur = cohort._window(leaves, 0, gs)
+        cur = pkernel.kstep(cfg, cur, 0, 1)
+        jax.block_until_ready(cur)
+        cohort._writeback(leaves, cur, 0, gs)
+        cohort._writeback(leaves, cur, 0, gs)        # <- stale pending
+    return events
+
+
+def synthetic_slot_overwrite(cfg: RaftConfig, gs: int) -> List[Event]:
+    """BUG: a depth-3 prefetch over the depth-2 StagingPool — window
+    i+2 restages the slot window i staged while window i still has no
+    completion evidence (exactly the "deeper prefetch would need more
+    slots" caveat, stream_sched.py:42-43)."""
+    import jax
+
+    from raft_tpu.parallel import stream_sched
+    from raft_tpu.sim import pkernel
+    step = pkernel.stream_blocks_per_device(cfg, 2) * 2 * SUB
+    gs = max(gs, 3 * step)
+    gs -= gs % step
+    leaves = _fake_leaves(gs)
+    events: List[Event] = []
+    mesh = _FakeMesh(2)
+    wins = [(s0, min(s0 + step, gs)) for s0 in range(0, gs, step)]
+    with capture(events):
+        pool = stream_sched.StagingPool(leaves, step)
+        resident = [stream_sched.put_window(leaves, *wins[i], mesh,
+                                            pool=pool, slot=i)
+                    for i in range(3)]               # <- depth-3 lookahead
+        for tok, (s0, s1) in zip(resident, wins[:3]):
+            from raft_tpu.parallel import kmesh
+            tok = kmesh.kstep_sharded(cfg, tok, 0, 1, mesh)
+            jax.block_until_ready(tok)
+            stream_sched.drain_window(leaves, tok, s0, s1)
+    return events
+
+
+def prove_negatives(log: Callable = None) -> dict:
+    """Run the synthetic buggy schedulers; each must be CAUGHT with the
+    expected rule (the prover's own mutation test). Returns
+    {"caught": n, "missed": [name, ...], "sites": {name: site}}."""
+    cfg = RaftConfig(seed=0, k=3, stream_groups=True, cohort_blocks=1)
+    gs2 = pkernel_step(cfg, 1) * 2
+    cases = (
+        ("use_after_free", synthetic_use_after_free(cfg, gs2),
+         "drain-before-sync", gs2),
+        ("double_drain", synthetic_double_drain(cfg, pkernel_step(cfg, 1)),
+         "double-drain", pkernel_step(cfg, 1)),
+        ("slot_overwrite", synthetic_slot_overwrite(
+            cfg, 3 * pkernel_step(cfg, 2)),
+         "staging-overwrite-in-flight", 3 * pkernel_step(cfg, 2)),
+    )
+    missed, sites = [], {}
+    for name, events, rule, gs in cases:
+        found = [h for h in check_trace(events, gs) if h.rule == rule]
+        if not found:
+            missed.append(name)
+        else:
+            sites[name] = found[0].site
+            if log:
+                log(f"negative {name}: caught at {found[0].site}")
+    return {"caught": len(cases) - len(missed), "missed": missed,
+            "sites": sites}
+
+
+def pkernel_step(cfg: RaftConfig, nd: int) -> int:
+    """Sublane step of one global window at `nd` devices (the
+    cohort_windows geometry, exposed for the fixtures)."""
+    from raft_tpu.sim import pkernel
+    return pkernel.stream_blocks_per_device(cfg, nd) * nd * SUB

@@ -1,0 +1,157 @@
+"""THE invariant source: Raft's safety properties as array predicates,
+generic over the array namespace (DESIGN.md §17).
+
+Every predicate takes raw per-node leaves plus `xp` — `numpy` when the
+bounded model checker (`verify/mcheck.py`) evaluates it on views of the
+CPU oracle's state, `jax.numpy` when `sim/check.py`'s per-tick fold
+evaluates it on `[G, K]` State leaves. One definition site means the
+runtime safety bit folded into `Metrics.safety` every tick is a
+spot-check of the SAME predicates the checker proves exhaustively at
+small scope — they cannot drift. (`pkernel._safety_tick` mirrors these
+on k-state tiles, statically unrolled; pinned by the kernel
+differential + scripts/check_metric_parity.py, the established kernel
+mirror rule.)
+
+Axis convention: the node axis is LAST for scalar leaves (`[..., K]`),
+second-to-last for ring leaves (`[..., K, L]`); leading batch axes
+broadcast through (check.py: `[G, K]`, mcheck: `[1, K]`). Predicates
+return `bool[...]` — one bit per group.
+
+This module is also the spec seam ROADMAP item 3 needs: a MultiPaxos
+engine sharing State/Mailbox checks against these exact predicates
+(election safety becomes per-slot ballot safety; log matching and
+leader completeness are the properties arXiv:2004.05074 shows are the
+only real deltas).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.core.node import LEADER
+
+
+def slot_abs_index(snap_index, log_cap: int, xp=np):
+    """`[..., L]` absolute index assigned to each ring slot: entry at
+    absolute index i lives in slot (i-1) % L on EVERY node, so slot s
+    under window (snap, snap+L] holds snap + 1 + ((s - snap) mod L) —
+    the same formula as `step._abs_index` / `pkernel._abs_index`,
+    written without a negative-operand mod."""
+    s = xp.arange(log_cap, dtype=snap_index.dtype)
+    off = s - snap_index[..., None] % log_cap
+    return snap_index[..., None] + 1 + xp.where(off >= 0, off,
+                                                off + log_cap)
+
+
+def election_safety(role, term, xp=np):
+    """No two current leaders share a term (point-in-time form of
+    cluster._check_election_safety; crashed leaders hold their term)."""
+    k = role.shape[-1]
+    ok = xp.ones(role.shape[:-1], dtype=bool)
+    for a in range(k):
+        for b in range(a + 1, k):
+            clash = ((role[..., a] == LEADER) & (role[..., b] == LEADER)
+                     & (term[..., a] == term[..., b]))
+            ok = ok & ~clash
+    return ok
+
+
+def digest_agreement(applied, digest, xp=np):
+    """State-machine safety witness: nodes that applied the same prefix
+    hold the same state-machine digest (cluster._on_apply's commit-
+    identity invariant, collapsed to the digest chain)."""
+    k = applied.shape[-1]
+    ok = xp.ones(applied.shape[:-1], dtype=bool)
+    for a in range(k):
+        for b in range(a + 1, k):
+            clash = ((applied[..., a] == applied[..., b])
+                     & (digest[..., a] != digest[..., b]))
+            ok = ok & ~clash
+    return ok
+
+
+def window_bounds(applied, commit, snap_index, last_index, log_cap: int,
+                  xp=np):
+    """Per-node structural sanity: applied == commit (phase A drains),
+    snap <= commit <= last, window within the ring capacity."""
+    ok = ((applied == commit)
+          & (snap_index <= commit) & (commit <= last_index)
+          & (last_index - snap_index <= log_cap))
+    return xp.all(ok, axis=-1)
+
+
+def log_matching(last_index, snap_index, log_term, log_payload,
+                 log_cap: int, xp=np):
+    """If two logs hold an entry with the same index and term, the
+    entries carry the same payload (Raft's Log Matching property,
+    point-in-time, per overlapping ring lane). Slot identity makes the
+    pairwise compare elementwise: slot s holds the same absolute index
+    on both nodes exactly when their computed slot indices agree."""
+    k = last_index.shape[-1]
+    ok = xp.ones(last_index.shape[:-1], dtype=bool)
+    absidx = slot_abs_index(snap_index, log_cap, xp)      # [..., K, L]
+    for a in range(k):
+        for b in range(a + 1, k):
+            live = ((absidx[..., a, :] == absidx[..., b, :])
+                    & (absidx[..., a, :] <= last_index[..., a, None])
+                    & (absidx[..., b, :] <= last_index[..., b, None]))
+            m = live & (log_term[..., a, :] == log_term[..., b, :])
+            agree = xp.all(
+                xp.where(m, log_payload[..., a, :] == log_payload[..., b, :],
+                         True), axis=-1)
+            ok = ok & agree
+    return ok
+
+
+def leader_completeness(role, term, commit, last_index, snap_index,
+                        log_payload, log_cap: int, xp=np):
+    """A current leader holds every entry any node has committed up to
+    its own term (Raft Figure 3's Leader Completeness, point-in-time):
+    for each ordered pair (a, b) with role_a == LEADER and
+    term_a >= term_b, (1) commit_b <= last_index_a, and (2) on every
+    ring lane where both nodes' slots map to the same absolute index
+    within b's committed prefix and a's log, the payloads agree.
+
+    Why sound: every entry in b's committed prefix was committed under
+    a leader of term <= term_b <= term_a (accepting a commit index
+    raises b's term to at least the committing leader's); by quorum
+    intersection + the §5.4.2 current-term commit rule, the leader of
+    term_a holds all of them, and leaders never truncate their own
+    log. Payloads (not terms) are compared because takeover re-terms
+    the top entry in place — commit identity is (index, payload).
+    Entries below a's snap_index are excluded structurally (slot
+    indices live in (snap_a, snap_a + L]); b's restart rewind only
+    shrinks commit_b, weakening nothing."""
+    k = role.shape[-1]
+    ok = xp.ones(role.shape[:-1], dtype=bool)
+    absidx = slot_abs_index(snap_index, log_cap, xp)      # [..., K, L]
+    for a in range(k):
+        for b in range(k):
+            if a == b:
+                continue
+            cond = (role[..., a] == LEADER) & (term[..., a] >= term[..., b])
+            holds = commit[..., b] <= last_index[..., a]
+            lim = xp.minimum(commit[..., b], last_index[..., a])
+            m = ((absidx[..., a, :] == absidx[..., b, :])
+                 & (absidx[..., a, :] <= lim[..., None]))
+            agree = xp.all(
+                xp.where(m, log_payload[..., a, :] == log_payload[..., b, :],
+                         True), axis=-1)
+            ok = ok & (~cond | (holds & agree))
+    return ok
+
+
+def client_safety(applied, session_seq, done, xp=np):
+    """The r09 exactly-once invariant (DESIGN.md §10): nodes with the
+    same applied prefix hold element-identical (sid -> seq) dedup
+    tables, and no table entry exceeds the slot's issued frontier.
+    `session_seq` is `[..., K, S]`, `done` is `[..., S]`."""
+    k = session_seq.shape[-2]
+    ok = xp.all(session_seq <= done[..., None, :], axis=(-2, -1))
+    for a in range(k):
+        for b in range(a + 1, k):
+            clash = ((applied[..., a] == applied[..., b])
+                     & xp.any(session_seq[..., a, :] != session_seq[..., b, :],
+                              axis=-1))
+            ok = ok & ~clash
+    return ok

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# The pre-push gate (DESIGN.md §17, README "Verification"): every
+# chip-free verification pass in one command, sized to run in well
+# under a minute on a laptop —
+#
+#   - engine-contract audit (pytrees vs kernel wire registries vs shard
+#     rule vs checkpoint format + derived byte model),
+#   - purity/determinism lint over the full tick + scheduler surface,
+#   - depth-limited bounded model-checker smoke (exhaustive clean
+#     oracle at tiny scope + a seeded-mutant canary kill),
+#   - stream-scheduler hazard prover (real r16/r17 pipelines over the
+#     bound grid + synthetic negatives caught with file:line).
+#
+# All four are `static_audit --level deep` (analysis/cli.py); rc != 0
+# names the violated contract/invariant. Run before pushing:
+#
+#   scripts/ci_static.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+exec python scripts/static_audit.py --level deep "$@"

@@ -63,6 +63,28 @@ def _xla_vs_kernel_pair(cfg):
 
 
 def replay(path: str, n_groups: int) -> int:
+    # Dispatch on artifact kind: model-checker counterexamples
+    # (verify/mcheck.py reproducers — an explicit per-tick scheduler
+    # trace on the CPU oracle) share the nemesis artifact schema but
+    # replay through the checker's own universe, not the XLA engines.
+    import json as _json
+    with open(path) as fh:
+        kind = _json.load(fh).get("kind")
+    if kind == "mcheck-reproducer":
+        from raft_tpu.verify import mcheck
+        art = mcheck.load_reproducer(path)
+        log(f"replaying {path}: mcheck scheduler trace, "
+            f"{art['n_ticks']} tick(s), mutant "
+            f"{art.get('mutant') or '<real oracle>'}, expecting tick "
+            f"{art['violation']['tick']} leaf "
+            f"{art['violation']['leaf']!r}")
+        try:
+            rep = mcheck.replay(art)
+        except AssertionError as e:
+            log(f"REPLAY FAILED: {e}")
+            return 1
+        log(f"replay ok: tick {rep['tick']} — {rep['predicates']}")
+        return 0
     cfg, artifact = nsearch.load_reproducer(path)
     n_ticks = artifact["n_ticks"]
     # The artifact's own run shape wins — the violating group must
@@ -111,7 +133,14 @@ def main() -> int:
                     help="where a shrunk violation artifact is written")
     ap.add_argument("--replay", default=None, metavar="ARTIFACT",
                     help="replay a reproducer artifact instead of "
-                         "searching (rc 1 on drift)")
+                         "searching (rc 1 on drift); accepts both "
+                         "nemesis and verify/mcheck artifacts "
+                         "(dispatched on the artifact's `kind`)")
+    ap.add_argument("--corpus", default=None, metavar="DIR",
+                    help="persist/reload the coverage corpus: seed the "
+                         "hunt from every program in DIR, write every "
+                         "coverage-novel program back (accumulates "
+                         "across runs)")
     ap.add_argument("--check-kernel", action="store_true",
                     help="after the hunt, run the best program through "
                          "the interpret-mode Pallas kernel and bisect "
@@ -163,13 +192,23 @@ def main() -> int:
         nsearch.verify_reproducer(artifact, repro)
         log("replay verified: same tick + leaf")
         return 0
+    seed_corpus = None
+    if args.corpus:
+        seed_corpus = nsearch.load_corpus(args.corpus)
+        if seed_corpus:
+            log(f"corpus: seeded {len(seed_corpus)} program(s) from "
+                f"{args.corpus}")
     log(f"hunting: {args.groups} groups x {args.ticks} ticks per "
         f"candidate, budget {args.budget}, seed {args.seed}")
     res = nsearch.search(base, args.groups, args.ticks, args.budget,
-                         seed=args.seed, log=log)
+                         seed=args.seed, log=log,
+                         seed_corpus=seed_corpus)
     log(f"corpus: {len(res['corpus'])} program(s), "
         f"{len(res['coverage'])} coverage signature(s); best score "
         f"{res['best_score']:.1f}: {describe(res['best'])}")
+    if args.corpus:
+        n = nsearch.save_corpus(args.corpus, res["corpus"])
+        log(f"corpus: persisted {n} program(s) -> {args.corpus}")
 
     rc = 0
     if res["violations"]:
