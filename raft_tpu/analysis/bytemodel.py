@@ -440,6 +440,212 @@ def derived_wire_model(cfg: RaftConfig, with_flight: bool = True) -> dict:
     }
 
 
+# Hand-pinned RESIDENT bytes/group (flight off) for the audited narrow
+# layouts: {label: (wide, narrow-all-dials)}. These are the §18
+# headline claims — the four derived accountings in
+# `resident_bytes_model` must land on them EXACTLY, so a dtype-map edit
+# that moves the resident footprint cannot ship without re-pinning here
+# (the same no-silent-drift rule as the 8,308 / 11,056 / 3,552 wire
+# pins). The reduction floor is the r19 acceptance bar.
+_RESIDENT_PINS = {"headline": (4034, 2494), "clients": (4734, 2842)}
+_NARROW_REDUCTION_FLOOR_PCT = 35.0
+
+
+def resident_bytes_model(cfg: RaftConfig, with_flight: bool = False
+                         ) -> dict:
+    """The r19 narrow-native RESIDENT byte model (DESIGN.md §18): what
+    one group keeps in HBM across the XLA scan carry, derived FOUR
+    independent ways and reconciled exactly:
+
+    1. the real `sim.init` output under `cfg`'s narrow dials, traced
+       with `eval_shape` (what the engine actually keeps resident);
+    2. the wide leaf shapes priced at `sim.state.narrow_spec`'s dtypes
+       (the dtype map applied arithmetically, no narrowing code run);
+    3. the wide total minus the per-leaf narrowing deltas
+       (wide-minus-deltas — a different summation order, so a leaf the
+       spec names but `narrow_state` misses cannot self-agree);
+    4. the hand-pinned `_RESIDENT_PINS` constants (audited labels only).
+
+    Metric [G]/scalar lanes and the flight rings are deliberately NOT
+    narrowed (the fold arithmetic is audited at i32 — run._run_impl)
+    and are priced identically on both sides. Wire invariance — the
+    kernel wire, `supported()` and both streamed ceilings must not move
+    under the dials — is asserted by `byte_model_problems`, which runs
+    `derived_wire_model` on narrow/wide twins and compares."""
+    import numpy as np
+
+    from raft_tpu.config import NARROW_FIELDS
+    from raft_tpu.obs.recorder import FLIGHT_LEAVES, RING
+    from raft_tpu.sim import pkernel
+    from raft_tpu.sim import state as state_mod
+
+    problems: list[str] = []
+    wide_cfg = dataclasses.replace(cfg, **{f: False for f in NARROW_FIELDS})
+    st_n, _, _, _ = _specs(cfg, with_flight=False)
+    st_w, _, _, _ = _specs(wide_cfg, with_flight=False)
+    spec = state_mod.narrow_spec(cfg)
+
+    rows = []
+    seen = set()
+    state_wide = state_narrow_real = state_narrow_spec = delta = 0
+    for (name_w, lw), (name_n, ln) in zip(iter_named_leaves(st_w),
+                                          iter_named_leaves(st_n)):
+        if name_w != name_n:
+            problems.append(f"narrow/wide leaf walks diverged: "
+                            f"{name_n!r} vs {name_w!r}")
+            continue
+        seen.add(name_w)
+        per_group = tuple(lw.shape)[1:]
+        words = int(np.prod(per_group, dtype=np.int64)) if per_group else 1
+        it_w = np.dtype(lw.dtype).itemsize
+        dt_spec = spec.get(name_w)
+        it_spec = np.dtype(dt_spec).itemsize if dt_spec is not None else it_w
+        # The real narrow leaf's dtype must BE the spec's (or the wide
+        # one when unlisted) — a narrow_state that skips a spec'd leaf
+        # or narrows an unlisted one fails here, not silently.
+        want = np.dtype(dt_spec) if dt_spec is not None else np.dtype(lw.dtype)
+        if np.dtype(ln.dtype) != want:
+            problems.append(
+                f"state leaf {name_w}: narrow init dtype {ln.dtype} != "
+                f"{want} promised by narrow_spec")
+        state_wide += it_w * words
+        state_narrow_real += np.dtype(ln.dtype).itemsize * words
+        state_narrow_spec += it_spec * words
+        delta += (it_w - it_spec) * words
+        rows.append({
+            "name": name_w, "dtype_wide": str(np.dtype(lw.dtype)),
+            "dtype_narrow": str(np.dtype(ln.dtype)),
+            "shape_per_group": list(per_group),
+            "bytes_wide": it_w * words,
+            "bytes_narrow": it_spec * words,
+            "narrowed": dt_spec is not None,
+        })
+    for name in spec:
+        if name not in seen:
+            problems.append(f"narrow_spec names {name!r} but no such leaf "
+                            f"exists under this cfg — a dead dtype-map "
+                            f"entry (or a walk that skipped it)")
+
+    # Metric lanes ride wide on both sides — one 4-byte lane per active
+    # non-row leaf, the same lane convention as _state_words_per_group's
+    # scalar tail (scalars accumulate per group in-kernel).
+    lane_bytes = 4 * sum(1 for n in pkernel._active_metric_leaves(cfg)
+                         if n not in pkernel.ROW_METRIC_LEAVES)
+    flight_bytes = 4 * RING * len(FLIGHT_LEAVES) if with_flight else 0
+    tail = lane_bytes + flight_bytes
+
+    wide_total = state_wide + tail
+    narrow_real = state_narrow_real + tail
+    narrow_spec_total = state_narrow_spec + tail
+    narrow_delta = wide_total - delta
+
+    if not (narrow_real == narrow_spec_total == narrow_delta):
+        problems.append(
+            f"narrow resident accountings disagree: real-init "
+            f"{narrow_real} vs spec-priced {narrow_spec_total} vs "
+            f"wide-minus-deltas {narrow_delta} B/group "
+            f"(with_flight={with_flight})")
+    reduction_pct = (100.0 * (wide_total - narrow_real) / wide_total
+                     if wide_total else 0.0)
+    return {
+        "leaves": rows,
+        "resident_bytes_wide": wide_total,
+        "resident_bytes_narrow": narrow_real,
+        "resident_bytes_narrow_spec": narrow_spec_total,
+        "resident_bytes_narrow_delta": narrow_delta,
+        "metric_lane_bytes": lane_bytes,
+        "flight_bytes": flight_bytes,
+        "reduction_pct": round(reduction_pct, 2),
+        "problems": problems,
+    }
+
+
+def narrow_resident_bytes_per_group(cfg: RaftConfig) -> int:
+    """The manifest figure (obs.manifest.NARROW_KEYS): resident
+    bytes/group under `cfg`'s narrow dials, flight off."""
+    return int(resident_bytes_model(cfg)["resident_bytes_narrow"])
+
+
+def all_dials_cfg(cfg: RaftConfig) -> RaftConfig:
+    """`cfg` with every narrow dial on (donation included — it changes
+    residency multiples, not the byte model)."""
+    from raft_tpu.config import NARROW_FIELDS
+    return dataclasses.replace(cfg, **{f: True for f in NARROW_FIELDS})
+
+
+def narrow_model_problems() -> list[str]:
+    """The r19 audit entry point: reconcile the four resident
+    accountings on the audited labels, pin the headline/clients
+    wide->narrow byte pairs exactly, hold the >= 35% all-dials
+    reduction floor, and prove WIRE invariance — the derived wire
+    model, `supported()` ceiling and both streamed ceilings must be
+    byte-identical between every narrow cfg and its all-dials-off
+    twin (the dials re-declare resident dtypes; the kernel wire
+    computes wide inside the chunk and never moves)."""
+    from raft_tpu.config import NARROW_FIELDS
+
+    out: list[str] = []
+    for label, base in (("headline", headline_cfg()),
+                        ("clients", clients_cfg())):
+        ncfg = all_dials_cfg(base)
+        model = resident_bytes_model(ncfg)
+        out.extend(f"narrow model [{label}]: {p}"
+                   for p in model["problems"])
+        pin_wide, pin_narrow = _RESIDENT_PINS[label]
+        if model["resident_bytes_wide"] != pin_wide:
+            out.append(f"narrow model [{label}]: derived wide resident "
+                       f"{model['resident_bytes_wide']} B/group != pinned "
+                       f"{pin_wide}")
+        if model["resident_bytes_narrow"] != pin_narrow:
+            out.append(f"narrow model [{label}]: derived narrow resident "
+                       f"{model['resident_bytes_narrow']} B/group != "
+                       f"pinned {pin_narrow}")
+        if model["reduction_pct"] < _NARROW_REDUCTION_FLOOR_PCT:
+            out.append(
+                f"narrow model [{label}]: all-dials reduction "
+                f"{model['reduction_pct']}% is under the "
+                f"{_NARROW_REDUCTION_FLOOR_PCT}% r19 floor")
+        # Wire invariance: every wire figure a ceiling/budget reads must
+        # be identical across the dial flip.
+        for wf in (True, False):
+            wn = derived_wire_model(ncfg, with_flight=wf)
+            ww = derived_wire_model(base, with_flight=wf)
+            for key in ("wire_words_derived", "wire_words_pinned",
+                        "kinit_words_per_group"):
+                if wn[key] != ww[key]:
+                    out.append(
+                        f"narrow model [{label}, flight="
+                        f"{'on' if wf else 'off'}]: {key} moved under the "
+                        f"narrow dials ({ww[key]} -> {wn[key]}) — the wire "
+                        f"must be layout-invariant")
+            hn, hw = wn["hbm"], ww["hbm"]
+            if (hn["ceiling_groups"], hn["streamed"]["ceiling_groups"],
+                hn["streamed"]["sharded"]["ceiling_groups"]) != \
+               (hw["ceiling_groups"], hw["streamed"]["ceiling_groups"],
+                    hw["streamed"]["sharded"]["ceiling_groups"]):
+                out.append(
+                    f"narrow model [{label}, flight="
+                    f"{'on' if wf else 'off'}]: an HBM/streamed ceiling "
+                    f"moved under the narrow dials")
+        # Dials-off is the identity: the narrow model of the WIDE cfg
+        # must report zero reduction and an empty dtype map.
+        wmodel = resident_bytes_model(base)
+        if (wmodel["resident_bytes_narrow"]
+                != wmodel["resident_bytes_wide"]):
+            out.append(f"narrow model [{label}]: dials-off cfg reports a "
+                       f"nonzero reduction — narrowing leaked past its "
+                       f"dials")
+    # A lone donate_scan dial changes residency multiples, never the
+    # byte model or any leaf dtype.
+    dcfg = dataclasses.replace(headline_cfg(), donate_scan=True)
+    dmodel = resident_bytes_model(dcfg)
+    if dmodel["resident_bytes_narrow"] != dmodel["resident_bytes_wide"]:
+        out.append("narrow model: a lone donate_scan dial changed the "
+                   "resident byte model — donation must not touch dtypes")
+    assert NARROW_FIELDS  # the registry the dials-off twin is built from
+    return out
+
+
 def audit_cfgs() -> list:
     """(label, cfg) pairs every audit derives and reconciles: the two
     published baselines (8,308 B/group headline, 11,056 B/group client
@@ -456,6 +662,11 @@ def audit_cfgs() -> list:
         ("clients-packed", dataclasses.replace(clients_cfg(), **packed)),
         ("headline-ceiling", dataclasses.replace(
             headline_cfg(), alias_wire=True, wire_hist=False, **packed)),
+        # r19: the narrow-native layouts — the WIRE model must reconcile
+        # under the dials too (it is dial-invariant; the resident-side
+        # arithmetic is narrow_model_problems' job).
+        ("headline-narrow", all_dials_cfg(headline_cfg())),
+        ("clients-narrow", all_dials_cfg(clients_cfg())),
     ]
 
 
@@ -469,4 +680,5 @@ def byte_model_problems() -> list[str]:
             model = derived_wire_model(cfg, with_flight=wf)
             out.extend(f"byte model [{label}, flight={'on' if wf else 'off'}]"
                        f": {p}" for p in model["problems"])
+    out.extend(narrow_model_problems())
     return out

@@ -759,6 +759,201 @@ def packing_problems(include_behavioral: bool = True) -> list[str]:
     return problems
 
 
+# ------------------------------------------------- narrow-native layout
+
+
+def _narrow_cfgs() -> dict:
+    """label -> config with that narrow dial set on (r19)."""
+    base = _base_cfg()
+    every = dict(narrow_scalars=True, narrow_ring=True,
+                 narrow_mailbox=True, narrow_clients=True)
+    return {
+        "narrow_scalars": dataclasses.replace(base, narrow_scalars=True),
+        "narrow_ring": dataclasses.replace(base, narrow_ring=True),
+        "narrow_mailbox": dataclasses.replace(base, narrow_mailbox=True),
+        "donate_scan": dataclasses.replace(base, donate_scan=True),
+        "narrow-all": dataclasses.replace(base, **every),
+        "narrow-clients": dataclasses.replace(_gate_cfgs()["clients"],
+                                              **every),
+    }
+
+
+def narrowing_problems(include_behavioral: bool = True) -> list[str]:
+    """The r19 narrow-native layout contracts (DESIGN.md §18):
+
+    - the dials are LAYOUT-ONLY in structure: flipping any of them
+      changes zero State leaf NAMES or shapes (only resident dtypes),
+      and with every dial off `narrow_spec` is empty — the resident
+      form is byte-identical to r18;
+    - `config_hash` is dial-invariant (a narrow-vs-wide ablation pair
+      for one universe must be pairable), and every NARROW_FIELDS dial
+      defaults to False;
+    - `narrow_spec` agrees with the real narrow init's dtypes leaf by
+      leaf and names only leaves that exist (the byte model's four-way
+      resident reconciliation, delegated to
+      `bytemodel.narrow_model_problems`);
+    - the kernel wire is dial-invariant and every wire leaf under a
+      narrow cfg still lands in the folded [..., GS, LANE] layout
+      `kmesh.kleaf_spec` shards;
+    - (behavioral) the overflow latch fires for EVERY narrowed leaf —
+      an out-of-range wide value must latch bit 31 of group_id, make
+      `check_narrow_overflow` refuse, and stay sticky; and a
+      checkpoint hops the narrow axis both ways by NAME, values
+      preserved exactly.
+    """
+    import jax
+    import numpy as np
+
+    from raft_tpu import sim
+    from raft_tpu.config import NARROW_FIELDS, RaftConfig
+    from raft_tpu.obs.manifest import config_hash
+    from raft_tpu.sim import pkernel
+    from raft_tpu.sim import state as state_mod
+
+    problems = []
+    for f in NARROW_FIELDS:
+        if getattr(RaftConfig(), f) is not False:
+            problems.append(f"narrow dial {f!r} does not default to False "
+                            f"— the r18 layout must be the default")
+    base = _base_cfg()
+    if state_mod.narrow_spec(base) or state_mod.narrow_active(base):
+        problems.append("narrow_spec is non-empty with every dial off — "
+                        "the wide layout must be exactly r18's")
+
+    def shapes(cfg):
+        st = jax.eval_shape(lambda: sim.init(cfg, n_groups=2))
+        from raft_tpu.analysis.bytemodel import iter_named_leaves
+        return {name: tuple(leaf.shape)
+                for name, leaf in iter_named_leaves(st)}
+
+    for label, cfg in _narrow_cfgs().items():
+        off = dataclasses.replace(cfg,
+                                  **{f: False for f in NARROW_FIELDS})
+        if shapes(cfg) != shapes(off):
+            problems.append(
+                f"[{label}] narrow dials changed State leaf names/shapes "
+                f"— they may only re-declare dtypes")
+        if config_hash(cfg) != config_hash(off):
+            problems.append(
+                f"[{label}] config_hash moved under the narrow dials — "
+                f"ablation pairs for one universe must hash equal")
+        # Wire invariance + shard-rule coverage of the narrow cfg: the
+        # kernel computes wide inside the chunk, so kinit's wire leaves
+        # must be untouched by the dials and stay kleaf_spec-shardable.
+        from raft_tpu.obs.recorder import flight_init
+        from raft_tpu.parallel.kmesh import AXIS, kleaf_spec
+
+        def kspecs(c):
+            st = jax.eval_shape(lambda: sim.init(c, n_groups=2))
+            fl = jax.eval_shape(lambda: flight_init(2))
+            return jax.eval_shape(
+                lambda s, f: pkernel.kinit(c, s, None, f)[0], st, fl)
+        on_leaves, off_leaves = kspecs(cfg), kspecs(off)
+        if [(tuple(a.shape), str(a.dtype)) for a in on_leaves] \
+                != [(tuple(a.shape), str(a.dtype)) for a in off_leaves]:
+            problems.append(
+                f"[{label}] kinit's wire leaves moved under the narrow "
+                f"dials — the wire is a layout the dials must not touch")
+        for i, leaf in enumerate(on_leaves):
+            spec = kleaf_spec(leaf)
+            if len(spec) != leaf.ndim or spec[-2] != AXIS \
+                    or spec[-1] is not None:
+                problems.append(
+                    f"[{label}] kleaf_spec does not shard wire leaf #{i} "
+                    f"(shape {tuple(leaf.shape)}) on the [..., GS, LANE] "
+                    f"group axis under the narrow cfg")
+
+    # The four-way resident byte reconciliation (derived / spec-priced /
+    # wide-minus-deltas / pinned) + the >= 35% floor + wire-ceiling
+    # invariance, at the published configs.
+    from raft_tpu.analysis import bytemodel
+    problems += bytemodel.narrow_model_problems()
+
+    if not include_behavioral:
+        return problems
+    import jax.numpy as jnp
+
+    from raft_tpu.utils.trees import trees_equal_values, trees_equal_why
+
+    # Latch coverage: EVERY narrowed leaf, driven out of range, must
+    # latch (group 0 only), refuse the host boundary, and stay sticky
+    # across a clean re-narrow.
+    ncfg = _narrow_cfgs()["narrow-clients"]
+    spec = state_mod.narrow_spec(ncfg)
+    wide0 = state_mod.widen_state(ncfg, sim.init(ncfg, n_groups=2))
+    over = {state_mod.U16: 1 << 16, state_mod.I16: 1 << 15,
+            state_mod.I8: 1 << 10}
+    for name, dt in sorted(spec.items()):
+        def poke(path, leaf, name=name):
+            if path != name:
+                return leaf
+            flat = np.asarray(leaf).copy().reshape(leaf.shape[0], -1)
+            flat[0, 0] = over[dt]
+            return jnp.asarray(flat.reshape(leaf.shape))
+        bad = state_mod._map_named(wide0, "", poke)
+        bad = bad._replace(group_id=wide0.group_id)
+        narrowed = state_mod.narrow_state(ncfg, bad)
+        ov = np.asarray(state_mod.narrow_overflow(narrowed))
+        if not (ov[0] and not ov[1:].any()):
+            problems.append(
+                f"overflow latch missed narrowed leaf {name!r} "
+                f"(latched groups: {np.flatnonzero(ov).tolist()})")
+            continue
+        try:
+            state_mod.check_narrow_overflow(ncfg, narrowed)
+            problems.append(f"check_narrow_overflow accepted a state "
+                            f"latched via {name!r}")
+        except ValueError:
+            pass
+        again = state_mod.narrow_state(
+            ncfg, state_mod.widen_state(ncfg, narrowed))
+        if not np.asarray(state_mod.narrow_overflow(again))[0]:
+            problems.append(f"overflow latch for {name!r} is not sticky "
+                            f"across widen/narrow")
+
+    # Narrow init is value-identical to wide init (values-only
+    # comparator), and strictly different (the dtypes really moved).
+    wide_init = sim.init(dataclasses.replace(
+        ncfg, **{f: False for f in NARROW_FIELDS}), n_groups=2)
+    narrow_init = sim.init(ncfg, n_groups=2)
+    ok, why = trees_equal_why(wide_init, narrow_init, values_only=True)
+    if not ok:
+        problems.append(f"narrow init diverges from wide init in VALUES: "
+                        f"{why}")
+    if trees_equal_why(wide_init, narrow_init)[0]:
+        problems.append("narrow init is byte-identical to wide init — "
+                        "the dials narrowed nothing")
+
+    # Checkpoint narrow-axis hop, both directions, values exact.
+    from raft_tpu.sim import checkpoint as ckpt
+    for src_cfg, dst_cfg, way in ((ncfg, None, "narrow->wide"),
+                                  (None, ncfg, "wide->narrow")):
+        wide_cfg = dataclasses.replace(ncfg,
+                                       **{f: False for f in NARROW_FIELDS})
+        s_cfg = src_cfg or wide_cfg
+        d_cfg = dst_cfg or wide_cfg
+        st = sim.init(s_cfg, n_groups=2)
+        buf = io.BytesIO()
+        ckpt.save(buf, st, 5, cfg=s_cfg)
+        buf.seek(0)
+        try:
+            loaded, t, _ = ckpt.load(buf, cfg=d_cfg)
+        except Exception as e:  # noqa: BLE001 — audited, not handled
+            problems.append(f"checkpoint {way} hop raised "
+                            f"{type(e).__name__}: {e}")
+            continue
+        if t != 5:
+            problems.append(f"checkpoint {way} hop lost the tick counter")
+        if not trees_equal_values(st, loaded):
+            problems.append(f"checkpoint {way} hop changed State VALUES")
+        want = sim.init(d_cfg, n_groups=2)
+        if not trees_equal_why(want, loaded)[0]:
+            problems.append(
+                f"checkpoint {way} hop did not land on the destination "
+                f"cfg's resident dtypes")
+    return problems
+
+
 # ---------------------------------------------------- nemesis compiler
 
 
@@ -1092,7 +1287,14 @@ def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
     problems = []
     keys = (real_manifest.ROOFLINE_KEYS + real_manifest.PACKING_KEYS
             + real_manifest.NEMESIS_KEYS + real_manifest.STREAM_KEYS
-            + real_manifest.STREAM_MESH_KEYS)
+            + real_manifest.STREAM_MESH_KEYS + real_manifest.NARROW_KEYS)
+    if tuple(real_history.R19_MANIFEST_KEYS) \
+            != tuple(real_manifest.NARROW_KEYS):
+        problems.append(
+            f"obs.history.R19_MANIFEST_KEYS {real_history.R19_MANIFEST_KEYS}"
+            f" != obs.manifest.NARROW_KEYS "
+            f"{real_manifest.NARROW_KEYS} — the emit-side and "
+            f"backfill-side key lists drifted")
     if tuple(real_history.R17_MANIFEST_KEYS) \
             != tuple(real_manifest.STREAM_MESH_KEYS):
         problems.append(
@@ -1128,7 +1330,13 @@ def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
             f" != obs.manifest.PACKING_KEYS "
             f"{real_manifest.PACKING_KEYS} — the emit-side and "
             f"backfill-side key lists drifted")
-    from raft_tpu.config import LAYOUT_FIELDS, STREAM_FIELDS
+    from raft_tpu.config import LAYOUT_FIELDS, NARROW_FIELDS, STREAM_FIELDS
+    if tuple(real_manifest.NARROW_KEYS[:len(NARROW_FIELDS)]) \
+            != tuple(NARROW_FIELDS):
+        problems.append(
+            f"obs.manifest.NARROW_KEYS {real_manifest.NARROW_KEYS} does "
+            f"not lead with config.NARROW_FIELDS {NARROW_FIELDS} — a "
+            f"narrow dial exists that manifests would not record")
     if tuple(real_manifest.PACKING_KEYS) != tuple(LAYOUT_FIELDS):
         problems.append(
             f"obs.manifest.PACKING_KEYS {real_manifest.PACKING_KEYS} != "
@@ -1159,14 +1367,18 @@ def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
                              stream_groups=True, cohort_blocks=2,
                              overlap_efficiency_predicted=0.75,
                              stream_devices=8, stream_blocks_per_device=1,
-                             stream_slowest_device=3)
+                             stream_slowest_device=3,
+                             narrow_scalars=True,
+                             narrow_resident_bytes_per_group=2494)
     for k, want in (("bound", "hbm"), ("attainment_pct", 12.5),
                     ("predicted_rounds_per_sec", 1.0),
                     ("pack_bools", True), ("wire_hist", False),
                     ("stream_groups", True), ("cohort_blocks", 2),
                     ("overlap_efficiency_predicted", 0.75),
                     ("stream_devices", 8), ("stream_blocks_per_device", 1),
-                    ("stream_slowest_device", 3)):
+                    ("stream_slowest_device", 3),
+                    ("narrow_scalars", True),
+                    ("narrow_resident_bytes_per_group", 2494)):
         if rec2.get(k) != want:
             problems.append(f"manifest dropped the caller's {k!r} value "
                             f"({rec2.get(k)!r} != {want!r})")
@@ -1231,6 +1443,7 @@ def contract_problems(include_behavioral: bool = True) -> list[str]:
     out += gating_problems()
     out += shard_rule_problems()
     out += packing_problems(include_behavioral=include_behavioral)
+    out += narrowing_problems(include_behavioral=include_behavioral)
     out += checkpoint_problems(include_behavioral=include_behavioral)
     out += nemesis_problems()
     out += streaming_problems(include_behavioral=include_behavioral)

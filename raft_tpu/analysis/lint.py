@@ -103,6 +103,19 @@ ELEMENTWISE_JNP = {
 ELEMENTWISE_METHODS = {"astype"}
 WORKLOAD_FNS = ("client_update", "submit_payloads")
 
+# r19 untagged-widening rule (DESIGN.md §18): the hot-loop modules
+# whose State-leaf dtypes are a CONTRACT under the narrow-native dials.
+# A bare `ns.term.astype(I32)` (or `jnp.int32(st.nodes.commit)`) inside
+# the tick silently re-declares a resident leaf wide, undoing the
+# narrow layout's byte win — every deliberate leaf cast must carry a
+# `# widen-ok` tag on its line (the annotation-allowlist idiom of the
+# elementwise rule). Casts of derived predicates/locals
+# (`cond.astype(I32)`) are not leaf re-declarations and pass untagged.
+WIDEN_TAG = "widen-ok"
+WIDENING_TARGETS = ("step.py", "pkernel.py", "workload.py")
+_DTYPE_CTORS = {"int8", "int16", "int32", "uint16", "uint32",
+                "float32", "bool_"}
+
 
 @dataclasses.dataclass
 class Finding:
@@ -395,6 +408,78 @@ def _lint_workload_elementwise(tree: ast.AST, path: str,
     return out
 
 
+def _is_leaf_chain(scope: _TracedScope, node) -> bool:
+    """Syntactic pytree-leaf read: an Attribute/Subscript chain (at
+    least one link, none of the static attrs) rooted at a traced Name —
+    `ns.term`, `st.nodes.commit`, `nd["votes"]`. Calls/operators in the
+    chain break it: their result is a derived value, not a leaf."""
+    links = 0
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+            return False
+        links += 1
+        node = node.value
+    return (links > 0 and isinstance(node, ast.Name)
+            and node.id in scope.traced)
+
+
+def _lint_untagged_widening(tree: ast.AST, path: str,
+                            src_lines: list[str]) -> list[Finding]:
+    """Flag `<leaf>.astype(...)` and `jnp.<dtype>(<leaf>)` casts of
+    State leaves in the hot-loop modules unless the line carries the
+    `# widen-ok` tag — see WIDENING_TARGETS above."""
+    out = []
+
+    def tagged(lineno: int) -> bool:
+        return (0 < lineno <= len(src_lines)
+                and WIDEN_TAG in src_lines[lineno - 1])
+
+    def visit_fn(fn: ast.FunctionDef, inherited: set):
+        scope = _TracedScope(fn, inherited)
+        scope.propagate(fn.body)
+        own, nested, stack = [], [], list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.append(node)
+                continue
+            own.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        for node in own:
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = None
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                    and _is_leaf_chain(scope, node.func.value)):
+                leaf = ast.unparse(node.func.value)
+                how = f"{leaf}.astype(...)"
+            else:
+                chain = _attr_chain(node.func)
+                if (len(chain) == 2 and chain[0] == "jnp"
+                        and chain[1] in _DTYPE_CTORS
+                        and any(_is_leaf_chain(scope, a)
+                                for a in node.args)):
+                    leaf = next(ast.unparse(a) for a in node.args
+                                if _is_leaf_chain(scope, a))
+                    how = f"jnp.{chain[1]}({leaf})"
+            if leaf is not None and not tagged(node.lineno):
+                out.append(Finding(
+                    path, node.lineno, "untagged-widening",
+                    f"{how} in {fn.name}() re-declares a State leaf's "
+                    f"dtype in a hot loop — under the narrow-native "
+                    f"dials (config.NARROW_FIELDS) leaf dtypes are a "
+                    f"layout contract; tag the line `# {WIDEN_TAG}` if "
+                    f"the cast is a deliberate boundary"))
+        for sub in nested:
+            visit_fn(sub, scope.traced)
+
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.FunctionDef):
+            visit_fn(node, set())
+    return out
+
+
 def lint_file(path: str, *, workload_rules: bool | None = None
               ) -> list[Finding]:
     """All rules over one file. `workload_rules` defaults to "is this
@@ -407,6 +492,8 @@ def lint_file(path: str, *, workload_rules: bool | None = None
         workload_rules = os.path.basename(path) == "workload.py"
     out = _lint_randomness(tree, path)
     out += _lint_traced_branches(tree, path)
+    if os.path.basename(path) in WIDENING_TARGETS:
+        out += _lint_untagged_widening(tree, path, src.splitlines())
     if workload_rules:
         out += _lint_workload_elementwise(tree, path)
     if os.path.basename(path) == "jrng.py":

@@ -27,6 +27,21 @@ I32 = jnp.int32
 CLIENT_LEAVES = ("done", "backlog", "inflight", "t_start", "t_sub",
                  "submit", "retries", "last_lat")
 
+# Narrow RESIDENT dtypes under cfg.narrow_clients (r19, DESIGN.md §18
+# range table) — the authority `sim.state.narrow_spec` prices
+# `clients.*` from, kept next to the NamedTuple so a new leaf cannot
+# ship without a dtype decision. Ranges: op counters / tick stamps fit
+# u16 under the <= 65,535-tick audited horizon (the sticky group_id
+# latch refuses past it); 0/1 pulses fit i8; last_lat needs a signed
+# lane for its -1 idle sentinel. The KERNEL wire stays i32 words
+# regardless (kinit widens, kfinish re-narrows).
+NARROW_CLIENT_SPEC = {
+    "done": jnp.uint16, "backlog": jnp.uint16, "t_start": jnp.uint16,
+    "t_sub": jnp.uint16, "retries": jnp.uint16,
+    "inflight": jnp.int8, "submit": jnp.int8,
+    "last_lat": jnp.int16,
+}
+
 
 class ClientState(NamedTuple):
     """One open-loop exactly-once client per (group, sid) slot."""

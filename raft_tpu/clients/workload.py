@@ -57,20 +57,25 @@ __all__ = ["CLIENT_LEAVES", "ClientState", "clients_init", "client_update",
 I32 = jnp.int32
 
 
-def clients_64_cfg() -> RaftConfig:
+def clients_64_cfg(**overrides) -> RaftConfig:
     """THE shared client-differential universe: 64 faulted k=3/L=8
     groups (kmesh.faulted_64_cfg's fault mix) carrying 3 retrying
     open-loop sessions per group. tests/test_clients.py's oracle
     differential, its kernel bit-parity test, and the checkpoint
     round-trip all simulate exactly this config so the clients-on tick
     compiles ONCE per machine (tests/conftest.py compile-cache
-    recipe)."""
-    return RaftConfig(n_groups=64, k=3, seed=29, log_cap=8, compact_every=4,
-                      sessions=True, cmds_per_tick=0,
-                      client_rate=0.3, client_slots=3,
-                      client_retry_backoff=5,
-                      drop_prob=0.05, crash_prob=0.2, crash_epoch=16,
-                      partition_prob=0.2, partition_epoch=16)
+    recipe). `overrides` layers dials on the pinned universe — the r19
+    narrow tests add `narrow_*` flags, which change resident dtypes
+    but not the compiled kernel program, so the shared compile still
+    serves."""
+    import dataclasses
+    cfg = RaftConfig(n_groups=64, k=3, seed=29, log_cap=8, compact_every=4,
+                     sessions=True, cmds_per_tick=0,
+                     client_rate=0.3, client_slots=3,
+                     client_retry_backoff=5,
+                     drop_prob=0.05, crash_prob=0.2, crash_epoch=16,
+                     partition_prob=0.2, partition_epoch=16)
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
 
 
 def workload_params(cfg: RaftConfig) -> dict:

@@ -88,6 +88,27 @@ LAYOUT_FIELDS = ("pack_bools", "pack_ring", "alias_wire", "wire_hist")
 # must change zero State pytree leaves and zero wire lanes).
 STREAM_FIELDS = ("stream_groups", "cohort_blocks")
 
+# Narrow-native dtype dials (r19, DESIGN.md §18): fields of RaftConfig
+# that change the NATIVE dtype the resident State/Mailbox/ClientState
+# leaves are carried at between ticks (u16 terms/indices, i8 roles,
+# real bools instead of i32-widened lanes) and whether the XLA scan
+# donates its carry buffers — but never what any engine computes per
+# tick: the tick body widens on entry and re-narrows on exit, so every
+# arithmetic op still runs at the audited i32/u32 widths and the
+# narrow form is value-identical to the wide one by construction
+# (overflow latches loudly, sim/state.narrow_state). Same layout-class
+# contract as LAYOUT_FIELDS/STREAM_FIELDS, kept as a third registry
+# because the earlier manifest/backfill key lists are pinned at their
+# widths by the contract auditor. One registry, consumed by
+# checkpoint.load (configs match modulo these — a narrow run may
+# resume a wide file and vice versa, widened/narrowed by leaf NAME on
+# load), by obs.manifest.config_hash (excluded), by the bench/sweep
+# manifests (obs.manifest.NARROW_KEYS lead with these names), and by
+# the contract auditor's narrowing pass (flipping one must change zero
+# State pytree leaves and zero wire lanes).
+NARROW_FIELDS = ("narrow_scalars", "narrow_ring", "narrow_mailbox",
+                 "narrow_clients", "donate_scan")
+
 
 def _prob_to_u32(p: float) -> int:
     """Map a probability to a uint32 threshold: event iff hash < threshold.
@@ -236,6 +257,40 @@ class RaftConfig:
     #   launch overhead, smaller ones shrink the HBM footprint.
     stream_groups: bool = False
     cohort_blocks: int = 4
+
+    # Narrow-native dtype dials (r19, DESIGN.md §18). LAYOUT-class
+    # knobs (NARROW_FIELDS above): none of them changes tick semantics
+    # — the CPU oracle ignores them entirely, the XLA scan carries the
+    # narrow form between ticks but computes every tick at the audited
+    # wide widths (sim/step.py widen-on-entry / narrow-on-exit), and
+    # the kernel wire form is untouched (its i32-word registries and
+    # every byte pin stay exactly r18's; the kernel widens at kinit and
+    # re-narrows at kfinish). All default off so the default pytrees,
+    # checkpoints, and compiled programs are byte-identical to r18.
+    #
+    # narrow_scalars: PerNode term/index/clock scalars drop to
+    #   u16/i16/i8 per the audited range proofs in sim/state.narrow_spec
+    #   (value-range table in DESIGN.md §18); out-of-range values latch
+    #   sticky bit 31 of group_id and the next host boundary refuses
+    #   loudly (never silent truncation).
+    # narrow_ring: the log_term ring rides u16 natively (terms are
+    #   u16-range in every benched universe; same latch on overflow) —
+    #   the resident twin of the pack_ring WIRE dial.
+    # narrow_mailbox: mailbox term/index/count payload lanes drop to
+    #   u16/i8; presence/grant/success bits stay real bools (they
+    #   already are — the i32 widening only ever existed on the wire).
+    # narrow_clients: ClientState + session dedup tables at
+    #   u16/i16/i8 (session seqs are 10-bit by construction,
+    #   config.SESSION_SEQ_MASK).
+    # donate_scan: donate the (state, metrics) carry into the jitted
+    #   XLA scan (donate_argnums twins of sim/run.run — the scan-path
+    #   analogue of alias_wire's kernel donation): one resident carry
+    #   copy instead of in+out.
+    narrow_scalars: bool = False
+    narrow_ring: bool = False
+    narrow_mailbox: bool = False
+    narrow_clients: bool = False
+    donate_scan: bool = False
 
     # Nemesis gray-failure program (DESIGN.md §14): a tuple of 8-int
     # clauses (kind, t0, t1, group_u32, p_u32, a, b, cid) built by

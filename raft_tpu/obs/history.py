@@ -94,6 +94,15 @@ R17_MANIFEST_KEYS = ("stream_devices", "stream_blocks_per_device",
                      "overlap_efficiency_per_device_measured",
                      "stream_slowest_device")
 
+# Manifest keys added by the r19 narrow-native layer (the resident-
+# dtype dials a segment ran with + the dial-set's resident bytes/group
+# from the §18 byte model) — same present-from-birth / backfilled-as-
+# null contract. Its own literal (the registry idiom), proven equal to
+# obs.manifest.NARROW_KEYS by the auditor.
+R19_MANIFEST_KEYS = ("narrow_scalars", "narrow_ring", "narrow_mailbox",
+                     "narrow_clients", "donate_scan",
+                     "narrow_resident_bytes_per_group")
+
 # Manifest records below this group count are smoke/--quick shapes:
 # correctness drives, not trajectory points — a 1K-group quick run's
 # rate joining the 100K series would trip (or mask) the regression
@@ -145,12 +154,14 @@ def _round_of(path: str) -> int | None:
 def backfill_record(rec: dict) -> dict:
     """A manifest record normalized to the current schema: the r12
     roofline/trace keys, the r13 wire-layout keys, the r14 nemesis
-    keys, the r16 streaming keys, AND the r17 sharded-streaming keys
-    present-but-null when the record predates them (same rule as the
-    mesh keys at r08). Returns a new dict."""
+    keys, the r16 streaming keys, the r17 sharded-streaming keys, AND
+    the r19 narrow-native keys present-but-null when the record
+    predates them (same rule as the mesh keys at r08). Returns a new
+    dict."""
     out = dict(rec)
     for k in (R12_MANIFEST_KEYS + R13_MANIFEST_KEYS + R14_MANIFEST_KEYS
-              + R16_MANIFEST_KEYS + R17_MANIFEST_KEYS):
+              + R16_MANIFEST_KEYS + R17_MANIFEST_KEYS
+              + R19_MANIFEST_KEYS):
         out.setdefault(k, None)
     return out
 

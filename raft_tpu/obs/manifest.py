@@ -103,6 +103,18 @@ STREAM_MESH_KEYS = ("stream_devices", "stream_blocks_per_device",
                     "overlap_efficiency_per_device_measured",
                     "stream_slowest_device")
 
+# r19 narrow-native keys: the resident-dtype dials (config.NARROW_FIELDS
+# by name, leading) the segment ran with, plus the dial-set's resident
+# bytes/group so a reader pricing a rate against the §18 byte model
+# never digs through the config dict. Present-but-null from birth (a
+# null = "pre-narrow schema or wide layout", which every pre-r19 record
+# trivially satisfies — the same rule as every registry above);
+# obs.history backfills them on read, proven both directions by the
+# auditor's manifest pass.
+NARROW_KEYS = ("narrow_scalars", "narrow_ring", "narrow_mailbox",
+               "narrow_clients", "donate_scan",
+               "narrow_resident_bytes_per_group")
+
 
 def config_hash(cfg) -> str:
     """Stable short hash of the SEMANTIC config — two runs with equal
@@ -113,10 +125,14 @@ def config_hash(cfg) -> str:
     pairable (the dials themselves are recorded via PACKING_KEYS).
     The r16 residency knobs (config.STREAM_FIELDS) follow the same
     rule: a streamed-vs-resident pair for one universe hashes equal
-    (the knobs themselves are recorded via STREAM_KEYS)."""
-    from raft_tpu.config import LAYOUT_FIELDS, STREAM_FIELDS
+    (the knobs themselves are recorded via STREAM_KEYS). The r19
+    narrow-native dials (config.NARROW_FIELDS) follow it too: the
+    narrow layout is a value-preserving re-declaration of the same
+    State, so a narrow-vs-wide ablation pair for one universe hashes
+    equal (the dials themselves are recorded via NARROW_KEYS)."""
+    from raft_tpu.config import LAYOUT_FIELDS, NARROW_FIELDS, STREAM_FIELDS
     d = dataclasses.asdict(cfg)
-    for k in LAYOUT_FIELDS + STREAM_FIELDS:
+    for k in LAYOUT_FIELDS + STREAM_FIELDS + NARROW_FIELDS:
         d.pop(k, None)
     blob = json.dumps(d, sort_keys=True)
     return hashlib.sha256(blob.encode()).hexdigest()[:12]
@@ -154,7 +170,8 @@ def emit_manifest(segment: str, cfg, device: str | None = None,
            # roofline/trace keys follow the same rule.
            "mesh_shape": None, "groups_per_device": None,
            **{k: None for k in ROOFLINE_KEYS + PACKING_KEYS
-              + NEMESIS_KEYS + STREAM_KEYS + STREAM_MESH_KEYS}}
+              + NEMESIS_KEYS + STREAM_KEYS + STREAM_MESH_KEYS
+              + NARROW_KEYS}}
     rec.update(fields)
     path = path or os.environ.get(MANIFEST_ENV) or DEFAULT_PATH
     if path != "-":

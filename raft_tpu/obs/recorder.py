@@ -31,7 +31,7 @@ from raft_tpu.config import RaftConfig
 from raft_tpu.core.node import LEADER
 from raft_tpu.sim import check
 from raft_tpu.sim.run import Metrics, metrics_init, metrics_update
-from raft_tpu.sim.state import I32, State
+from raft_tpu.sim.state import I32, State, widen_state
 from raft_tpu.sim.step import tick
 
 RING = 64   # ticks of history; slot t % RING holds tick t
@@ -126,8 +126,12 @@ def run_recorded(cfg: RaftConfig, st: State, n_ticks: int, t0=0,
     def body(carry, t):
         s, m, f = carry
         s = tick(cfg, s, t)
-        f = flight_update(cfg, f, s, m, t)
-        m = metrics_update(m, s, cfg.log_cap)
+        # Ring + metrics folds read the WIDE view (same convention as
+        # run._run_impl): the i32 ring values stay identical under the
+        # narrow dials while the scan carry stays narrow.
+        sw = widen_state(cfg, s)
+        f = flight_update(cfg, f, sw, m, t)
+        m = metrics_update(m, sw, cfg.log_cap)
         return (s, m, f), None
 
     (st, metrics, flight), _ = jax.lax.scan(

@@ -148,8 +148,17 @@ def tick_byte_model(cfg, n_groups: int, engine: str | None,
     else:
         chunk = None
         per_tick = 2 * resident * (-(-n_groups // nd))
+    # Scan-carry residency multiple (r19, DESIGN.md §18): donation
+    # (cfg.donate_scan) lets XLA write the carry in place, halving PEAK
+    # residency from in+out copies to one — but the read+write traffic
+    # FLOOR per tick is unchanged (per_tick above stays 2x resident),
+    # so donation moves the residency ceiling, never this prediction.
+    # Honest by construction: a donated run that got faster than the
+    # 2x-traffic ceiling would be a model bug, not a win.
+    scan_buffers = 1 if (cls == "xla" and cfg.donate_scan) else 2
     return {"engine_class": cls, "wire_bytes_per_group": wire,
             "resident_bytes_per_group": resident,
+            "scan_residency_buffers": scan_buffers,
             "bytes_per_tick_per_chip": per_tick,
             "chunk_ticks": chunk}
 
@@ -428,5 +437,28 @@ def stream_segment_fields(cfg, measured: float | None = None,
         raise RuntimeError(
             f"obs.manifest STREAM_KEYS+STREAM_MESH_KEYS "
             f"{set(STREAM_KEYS) | set(STREAM_MESH_KEYS)} drifted from "
+            f"the roofline producer {set(vals)}")
+    return vals
+
+
+def narrow_segment_fields(cfg) -> dict:
+    """The r19 manifest stamp (obs.manifest.NARROW_KEYS, null-by-default
+    in every record until stamped here): which narrow-native dials
+    (config.NARROW_FIELDS) the segment ran with, plus the dial-set's
+    resident bytes/group from the reconciled §18 byte model — so a
+    reader pricing a rate against the narrow layout never digs through
+    the config dict. Derived against the key registry so a
+    manifest-side rename cannot drift past this producer (the same
+    check as the stream stamp above)."""
+    from raft_tpu.analysis import bytemodel
+    from raft_tpu.config import NARROW_FIELDS
+    from raft_tpu.obs.manifest import NARROW_KEYS
+
+    vals = {k: getattr(cfg, k) for k in NARROW_FIELDS}
+    vals["narrow_resident_bytes_per_group"] = (
+        bytemodel.narrow_resident_bytes_per_group(cfg))
+    if set(vals) != set(NARROW_KEYS):
+        raise RuntimeError(
+            f"obs.manifest NARROW_KEYS {set(NARROW_KEYS)} drifted from "
             f"the roofline producer {set(vals)}")
     return vals

@@ -243,6 +243,10 @@ def prun_streamed(cfg: RaftConfig, st: State, n_ticks: int, t0: int = 0,
     into multiple launches (bench cadence)."""
     g = st.alive_prev.shape[0]
     wf = flight is not None
+    # r19 host boundary: refuse a latched narrow state before paging
+    # (the sticky latch would ride the whole stream otherwise).
+    from raft_tpu.sim import state as state_mod
+    state_mod.check_narrow_overflow(cfg, st)
     scfg = cfg if cfg.stream_groups else None
     if scfg is None:
         import dataclasses
@@ -441,6 +445,9 @@ def prun_streamed_sharded(cfg: RaftConfig, st: State, n_ticks: int,
     g = st.alive_prev.shape[0]
     wf = flight is not None
     nd = mesh.size
+    # r19 host boundary, same refusal as prun_streamed.
+    from raft_tpu.sim import state as state_mod
+    state_mod.check_narrow_overflow(cfg, st)
     scfg = cfg if cfg.stream_groups else None
     if scfg is None:
         import dataclasses

@@ -47,18 +47,25 @@ from raft_tpu.sim.run import HIST_SIZE, Metrics
 from raft_tpu.sim.state import I32, State
 
 
-def faulted_64_cfg() -> RaftConfig:
+def faulted_64_cfg(**overrides) -> RaftConfig:
     """THE shared sharded-differential universe: 64 faulted k=3/L=8
     groups (crash + partition + drop). tests/test_kmesh.py, the
     dryrun's `dryrun_pallas_mesh` segment, and multichip_sweep's
     CPU dryrun cells + interpret gate all simulate exactly this config
     so ONE interpret-mode kernel compile (minutes on the CPU box)
     serves every driver — defined once here so a drift in any driver
-    cannot silently turn the others back into cold compiles."""
-    return RaftConfig(n_groups=64, k=3, seed=23, drop_prob=0.05,
-                      crash_prob=0.2, crash_epoch=16,
-                      partition_prob=0.2, partition_epoch=16,
-                      log_cap=8, compact_every=4)
+    cannot silently turn the others back into cold compiles.
+    `overrides` layers dials on top of the pinned universe — the r19
+    narrow tests pass `narrow_scalars=True, ...`, which is free here:
+    the narrow dials re-declare RESIDENT dtypes only, the kernel wire
+    and compiled program are dial-invariant, so the shared interpret
+    compile still serves every variant."""
+    import dataclasses
+    cfg = RaftConfig(n_groups=64, k=3, seed=23, drop_prob=0.05,
+                     crash_prob=0.2, crash_epoch=16,
+                     partition_prob=0.2, partition_epoch=16,
+                     log_cap=8, compact_every=4)
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
 
 
 def kleaf_spec(a) -> P:
@@ -199,6 +206,10 @@ def prun_sharded(cfg: RaftConfig, st: State, n_ticks: int, mesh: Mesh,
     HBM budget)."""
     g = st.alive_prev.shape[0]
     wf = flight is not None
+    # r19 host boundary: a latched narrow state must refuse here, not
+    # compute garbage for n_ticks and refuse at kfinish.
+    from raft_tpu.sim import state as state_mod
+    state_mod.check_narrow_overflow(cfg, st)
     if not pkernel.supported(cfg, n_groups=g, n_devices=mesh.size,
                              with_flight=wf):
         raise ValueError(

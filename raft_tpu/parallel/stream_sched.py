@@ -79,6 +79,20 @@ def device_slices(mesh, leaf, s0: int, s1: int):
     return out
 
 
+def wire_word_problems(host_leaves) -> list[str]:
+    """Leaves of the paged wire that are NOT 4-byte words. The narrow
+    dials (config.NARROW_FIELDS, r19) re-declare RESIDENT dtypes only;
+    the wire the scheduler stages, pages, and budgets is i32/u32 words
+    by contract — the staging-pool slot arithmetic, `device_slices`'s
+    whole-block math and the hazard prover's window-byte model all
+    assume it. A narrow dtype leaking onto the host wire means kinit
+    skipped a widen; refuse loudly here instead of paging a corrupted
+    window."""
+    return [f"wire leaf #{i} is {a.dtype}, not a 4-byte word lane"
+            for i, a in enumerate(host_leaves)
+            if np.dtype(a.dtype).itemsize != 4]
+
+
 class StagingPool:
     """Reusable preallocated contiguous host staging buffers for the
     h2d path: one buffer per wire leaf per parity slot, sized for the
@@ -89,6 +103,10 @@ class StagingPool:
     SLOTS = 2
 
     def __init__(self, host_leaves, window_sublanes: int):
+        bad = wire_word_problems(host_leaves)
+        if bad:
+            raise ValueError("stream_sched: narrow dtype on the paged "
+                             "wire — " + "; ".join(bad))
         self._bufs = [
             tuple(np.empty(a.shape[:-2] + (window_sublanes,)
                            + a.shape[-1:], a.dtype)

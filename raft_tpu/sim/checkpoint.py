@@ -83,6 +83,11 @@ def save(path, st: State, t: int, metrics: Optional[Metrics] = None,
     silently continue the wrong universe otherwise)."""
     flat: dict = {"__version__": np.int64(_VERSION), "__tick__": np.int64(t)}
     if cfg is not None:
+        # Narrow-native host boundary (DESIGN.md §18): a latched state
+        # is invalid — refuse to persist it rather than freeze silent
+        # truncation into a file.
+        from raft_tpu.sim import state as state_mod
+        state_mod.check_narrow_overflow(cfg, st)
         flat["__cfg__"] = np.bytes_(
             json.dumps(dataclasses.asdict(cfg), sort_keys=True))
     _flatten("state.", st, flat)
@@ -118,6 +123,37 @@ def _load_nt(z, prefix: str, cls):
             raise KeyError(f"checkpoint missing field {key!r}")
         return jnp.asarray(z[key])
     return cls(**{f: get(f) for f in cls._fields})
+
+
+def _hop_narrow(cfg: RaftConfig, st: State) -> State:
+    """The narrow-axis hop (DESIGN.md §18): re-declare a loaded State at
+    the cfg's resident dtypes, BY NAME, in both directions — a wide
+    (incl. pre-r19) file narrows under a narrow cfg, a narrow file
+    widens under a wide cfg. A leaf at a dtype that is neither the wide
+    i32/u32/bool form nor the leaf's own narrow dtype
+    (sim/state.full_narrow_spec) is a corrupt/incompatible file and
+    refuses, naming the leaf; a wide value that does not FIT the target
+    narrow dtype refuses too (the overflow latch fires on the hop)."""
+    from raft_tpu.sim import state as state_mod
+    allowed = state_mod.full_narrow_spec(cfg)
+
+    def leaf(name, a):
+        if a.dtype in (jnp.int32, jnp.uint32, jnp.bool_):
+            return a
+        dt = allowed.get(name)
+        if dt is not None and a.dtype == dt:
+            return a.astype(jnp.int32)   # exact: zero/sign-extend
+        raise ValueError(
+            f"checkpoint leaf state.{name} has dtype {a.dtype}, which "
+            f"is neither the wide form nor its narrow-native dtype "
+            f"({dt}) — refusing the narrow-axis hop")
+
+    wide = state_mod._map_named(st, "", leaf)
+    out = state_mod.narrow_state(cfg, wide)
+    # A wide file whose values outgrow the target narrow dtypes latches
+    # on the hop — refuse at the boundary, like save does.
+    state_mod.check_narrow_overflow(cfg, out)
+    return out
 
 
 def load(path, cfg: Optional[RaftConfig] = None, sharding=None
@@ -168,8 +204,14 @@ def load(path, cfg: Optional[RaftConfig] = None, sharding=None
             # rule: a streamed run may resume a resident-layout file
             # (incl. every pre-r16 file) and vice versa — paging only
             # moves where the wire lives between chunk launches.
-            from raft_tpu.config import LAYOUT_FIELDS, STREAM_FIELDS
-            for k in LAYOUT_FIELDS + STREAM_FIELDS:
+            # The r19 narrow-native dials (config.NARROW_FIELDS) follow
+            # the same rule again: the narrow form is a value-preserving
+            # re-declaration of the same State (widen/narrow on load by
+            # leaf NAME below), so a narrow run may resume a wide file
+            # (incl. every pre-r19 file) and vice versa.
+            from raft_tpu.config import (LAYOUT_FIELDS, NARROW_FIELDS,
+                                         STREAM_FIELDS)
+            for k in LAYOUT_FIELDS + STREAM_FIELDS + NARROW_FIELDS:
                 saved.pop(k, None)
                 want.pop(k, None)
             if saved != want:
@@ -188,6 +230,10 @@ def load(path, cfg: Optional[RaftConfig] = None, sharding=None
             group_id=jnp.asarray(z["state.group_id"]),
             clients=clients,
         )
+        if cfg is not None:
+            # Hop the narrow axis both ways (no-op when the file's
+            # dtypes already match the cfg's resident form).
+            st = _hop_narrow(cfg, st)
         metrics = None
         if "metrics.committed" in z.files:
             md = {f: jnp.asarray(z[f"metrics.{f}"])

@@ -1694,27 +1694,35 @@ def _unfold_g(a):
     return a.reshape(a.shape[:-2] + (a.shape[-2] * a.shape[-1],))
 
 
+def _widen_klane(a):
+    """bool / narrow-native lanes (DESIGN.md §18) -> the i32 wire width.
+    u32 digest lanes pass through — the wire dtype map is exactly r18's
+    regardless of the narrow dials (every byte pin unchanged)."""
+    if a.dtype != I32 and a.dtype != jnp.uint32:
+        return a.astype(I32)
+    return a
+
+
 def _to_kstate(cfg, st: State):
     """State (G a GB multiple) -> flat list of k-state arrays (leaf
     order: node leaves, mailbox leaves, client-state leaves (clients
-    on), alive_prev, group_id; bools as i32; trailing G folded to
-    [GS, LANE]). Every leaf moves its leading G axis last — the one
-    transpose rule all ranks share ([G, K] -> [K, G],
-    [G, K, X] -> [K, X, G], [G, d, s, S] -> [d, s, S, G])."""
+    on), alive_prev, group_id; bools AND narrow-native lanes widened to
+    i32 — a narrow resident State enters the kernel through the same
+    unchanged wire; trailing G folded to [GS, LANE]). Every leaf moves
+    its leading G axis last — the one transpose rule all ranks share
+    ([G, K] -> [K, G], [G, K, X] -> [K, X, G],
+    [G, d, s, S] -> [d, s, S, G])."""
     out = []
     for f, _ in _node_leaves(cfg):
         a = jnp.moveaxis(getattr(st.nodes, f), 0, -1)
-        if a.dtype == jnp.bool_:
-            a = a.astype(I32)
-        out.append(_fold_g(a))
+        out.append(_fold_g(_widen_klane(a)))
     for f in _mb_fields(cfg):
         a = jnp.moveaxis(getattr(st.mailbox, f), 0, -1)
-        if a.dtype == jnp.bool_:
-            a = a.astype(I32)
-        out.append(_fold_g(a))
+        out.append(_fold_g(_widen_klane(a)))
     if cfg.clients_u32:
         for f in CLIENT_LEAVES:
-            out.append(_fold_g(jnp.moveaxis(getattr(st.clients, f), 0, -1)))
+            out.append(_fold_g(_widen_klane(
+                jnp.moveaxis(getattr(st.clients, f), 0, -1))))
     out.append(_fold_g(jnp.transpose(st.alive_prev, (1, 0)).astype(I32)))
     out.append(_fold_g(st.group_id))
     return out
@@ -2313,6 +2321,16 @@ def kfinish(cfg: RaftConfig, leaves, g: int,
     _check_ring_overflow(cfg, leaves, g)
     flat, _ = _unpack_wire(cfg, list(leaves[:n_state]))
     st = _from_kstate(cfg, [_unfold_g(a) for a in flat], g)
+    from raft_tpu.sim import state as state_mod
+    if state_mod.narrow_active(cfg):
+        # Narrow resident layout (DESIGN.md §18): the wire gid lane
+        # carried any pre-existing latch through the chunk untouched
+        # (the tick never writes group_id); re-narrowing here re-checks
+        # every narrowed leaf and the host boundary refuses a latched
+        # state loudly — the same refusal _check_ring_overflow gives
+        # the packed-ring wire dial.
+        st = state_mod.narrow_state(cfg, st)
+        state_mod.check_narrow_overflow(cfg, st)
     mc, ml, me, mx, ms = [
         _unfold_g(_mleaf(cfg, leaves, n))[:g]
         for n in ("committed", "leaderless", "elections", "max_latency",

@@ -49,7 +49,7 @@ import numpy as np
 from raft_tpu.config import RaftConfig
 from raft_tpu.core.node import LEADER
 from raft_tpu.sim import check
-from raft_tpu.sim.state import I32, State
+from raft_tpu.sim.state import I32, State, widen_state
 from raft_tpu.sim.step import tick
 
 HIST_SIZE = 512
@@ -139,14 +139,8 @@ def metrics_update(m: Metrics, st: State, log_cap: int) -> Metrics:
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2))
-def run(cfg: RaftConfig, st: State, n_ticks: int, t0=0,
-        metrics: Metrics | None = None):
-    """Run `n_ticks` global ticks starting at absolute tick `t0`.
-
-    Returns (state, metrics). Donatable; call again with the returned
-    state and `t0 + n_ticks` to continue the same deterministic universe.
-    """
+def _run_impl(cfg: RaftConfig, st: State, n_ticks: int, t0=0,
+              metrics: Metrics | None = None):
     if metrics is None:
         metrics = metrics_init(st.alive_prev.shape[0],
                                clients=st.clients is not None)
@@ -154,11 +148,45 @@ def run(cfg: RaftConfig, st: State, n_ticks: int, t0=0,
     def body(carry, t):
         s, m = carry
         s = tick(cfg, s, t)
-        return (s, metrics_update(m, s, cfg.log_cap)), None
+        # Metrics/safety fold on the WIDE view of the post-tick state —
+        # the predicates and histogram arithmetic stay at the audited
+        # i32 widths regardless of the narrow dials (a few fused
+        # elementwise casts; the scan carry itself stays narrow, which
+        # is where the resident-byte win lives — DESIGN.md §18).
+        return (s, metrics_update(m, widen_state(cfg, s),
+                                  cfg.log_cap)), None
 
     (st, metrics), _ = jax.lax.scan(
         body, (st, metrics), t0 + jnp.arange(n_ticks, dtype=I32))
     return st, metrics
+
+
+_run = jax.jit(_run_impl, static_argnums=(0, 2))
+# Donating twin (cfg.donate_scan, DESIGN.md §18): the (state, metrics)
+# carry buffers are released to the scan program, so XLA writes the
+# updated carry in place — one resident copy instead of in+out, the
+# scan-path analogue of the kernel's alias_wire donation
+# (pkernel.kstep / kmesh._kstep_sharded_donate). Same consumed-operand
+# contract: the caller's arrays are stale after the call, the way
+# every chunked driver already treats them.
+_run_donated = jax.jit(_run_impl, static_argnums=(0, 2),
+                       donate_argnums=(1, 4))
+
+
+def run(cfg: RaftConfig, st: State, n_ticks: int, t0=0,
+        metrics: Metrics | None = None):
+    """Run `n_ticks` global ticks starting at absolute tick `t0`.
+
+    Returns (state, metrics). Donatable; call again with the returned
+    state and `t0 + n_ticks` to continue the same deterministic universe.
+    Under `cfg.donate_scan` the input state/metrics buffers are donated
+    to the program (stale after the call); donation is skipped when no
+    metrics operand exists to donate, keeping the twin's signature
+    contract exact.
+    """
+    if cfg.donate_scan and metrics is not None:
+        return _run_donated(cfg, st, n_ticks, t0, metrics)
+    return _run(cfg, st, n_ticks, t0, metrics)
 
 
 TRACE_FIELDS = ("term", "role", "voted_for", "leader_id", "last_index",
@@ -175,8 +203,11 @@ def trace(cfg: RaftConfig, st: State, n_ticks: int, t0=0):
 
     def body(s, t):
         s = tick(cfg, s, t)
-        obs = {f: getattr(s.nodes, f) for f in TRACE_FIELDS}
-        obs["alive"] = s.alive_prev
+        # Trace rows are observed WIDE so the differential surface's
+        # dtypes match the oracle's regardless of the narrow dials.
+        sw = widen_state(cfg, s)
+        obs = {f: getattr(sw.nodes, f) for f in TRACE_FIELDS}
+        obs["alive"] = sw.alive_prev
         return s, obs
 
     return jax.lax.scan(body, st, t0 + jnp.arange(n_ticks, dtype=I32))

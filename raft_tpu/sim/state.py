@@ -26,6 +26,7 @@ automatically (utils/trees names leaves by pytree path).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import NamedTuple
 
 import jax.numpy as jnp
@@ -37,6 +38,9 @@ from raft_tpu.utils import jrng
 I32 = jnp.int32
 U32 = jnp.uint32
 BOOL = jnp.bool_
+I8 = jnp.int8
+I16 = jnp.int16
+U16 = jnp.uint16
 
 
 class PerNode(NamedTuple):
@@ -279,7 +283,7 @@ def init(cfg: RaftConfig, n_groups: int | None = None) -> State:
     if cfg.clients_u32:
         from raft_tpu.clients.state import clients_init
         clients = clients_init(cfg, g)
-    return State(
+    st = State(
         nodes=nodes,
         mailbox=empty_mailbox((g, k, k), cfg.prevote,
                               cfg.transfer_u32 != 0,
@@ -288,3 +292,207 @@ def init(cfg: RaftConfig, n_groups: int | None = None) -> State:
         group_id=jnp.arange(g, dtype=I32),
         clients=clients,
     )
+    # The RESIDENT form is the narrow one when any narrow dial is on
+    # (DESIGN.md §18): initial values are all in range, so this first
+    # narrowing can never latch.
+    return narrow_state(cfg, st)
+
+
+# --------------------------------------------------------------------------
+# Narrow-native resident layout (r19, DESIGN.md §18).
+#
+# The dtype map below is THE contract: which State leaves the
+# `narrow_*` dials re-declare at narrow native dtypes, keyed by the
+# leaf's checkpoint dot-path name (sim/checkpoint.iter_named_leaves).
+# Every other subsystem derives from it — the tick boundary
+# (sim/step.py widens on entry / narrows on exit), the kernel seam
+# (pkernel._to_kstate widens, kfinish re-narrows), checkpoint.load's
+# by-name narrow/widen hop, the bytemodel's narrow resident
+# accounting, and the contract auditor's narrowing pass.
+#
+# Range proofs (why each narrow dtype is sufficient — the full table
+# with per-leaf bounds lives in DESIGN.md §18):
+#   u16  terms / log indices / tick clocks: bounded by the run's term
+#        and index envelope; exceeding 65535 latches (below).
+#   i8   role (0..2), voted_for / leader_id (-1..k-1, kernel k <= 30),
+#        ae_req_n (0..E), client inflight/submit (0/1).
+#   i16  -1-sentinel lanes (ack_time, sched_read_index, session
+#        tables — session seqs are 10-bit by construction,
+#        config.SESSION_SEQ_MASK; last_lat ack latencies).
+# Deliberately kept wide: snap_digest / digest / is_req_snap_digest
+# (u32 hash chains), log_payload (full 30-bit command space),
+# group_id (i32 — it carries the overflow latch in bit 31 and feeds
+# the u32 seed hashes), and the Flight recorder rings (parity
+# machinery, not hot resident state).
+#
+# Overflow latch (the PR 13 sticky-bit idiom, pkernel._ring_base_ov):
+# a value that does not survive the narrow round-trip ORs bit 31 of
+# the group's `group_id` lane — sticky, because the tick never writes
+# group_id and `narrow_state` re-ORs it — and every host boundary
+# (checkpoint.save / kfinish / the run drivers) refuses a latched
+# state with a loud ValueError. Never silent corruption.
+
+_NARROW_LATCH = jnp.int32(-(2 ** 31))     # bit 31 of the i32 group_id
+
+# PerNode scalar lanes at u16 under narrow_scalars (nonnegative by
+# construction: terms, absolute log indices, monotone counters, clock
+# values — see DESIGN.md §18 for the per-leaf bound).
+_NODE_U16 = ("term", "snap_index", "snap_term", "rng_draws",
+             "last_index", "commit", "applied", "next_index",
+             "match_index", "election_elapsed", "heartbeat_elapsed",
+             "deadline", "leader_elapsed", "sched_read_reg",
+             "reads_done")
+# Mailbox term/index payload lanes at u16 under narrow_mailbox
+# (meaningful only under their presence bits; always nonnegative).
+_MB_U16 = ("rv_req_term", "rv_req_lli", "rv_req_llt", "rv_resp_term",
+           "ae_req_term", "ae_req_prev_index", "ae_req_prev_term",
+           "ae_req_commit", "ae_resp_term", "ae_resp_match",
+           "is_req_term", "is_req_snap_index", "is_req_snap_term",
+           "is_resp_term", "is_resp_match")
+# PreVote / TimeoutNow mailbox slots exist only under their schedules —
+# listed apart so narrow_spec maps exactly the leaves the cfg carries
+# (the byte-model audit flags any spec entry with no matching leaf).
+_MB_PV_U16 = ("pv_req_term", "pv_req_lli", "pv_req_llt", "pv_resp_term",
+              "pv_resp_req_term")
+# ClientState lanes under narrow_clients live with their NamedTuple:
+# clients.state.NARROW_CLIENT_SPEC (tick stamps / op counters at u16,
+# 0/1 flags at i8, -1-sentinel latency at i16).
+
+
+def narrow_spec(cfg: RaftConfig) -> dict:
+    """name -> narrow jnp dtype for every State leaf the cfg's narrow
+    dials re-declare (checkpoint dot-path names). Empty dict when all
+    narrow dials are off — THE gate every boundary helper below keys
+    on. `snap_voters` bitmasks narrow only when they fit 16 lanes."""
+    spec: dict = {}
+    if cfg.narrow_scalars:
+        for n in _NODE_U16:
+            spec[f"nodes.{n}"] = U16
+        for n in ("voted_for", "role", "leader_id"):
+            spec[f"nodes.{n}"] = I8
+        spec["nodes.ack_time"] = I16
+        spec["nodes.sched_read_index"] = I16
+        if cfg.k <= 16:
+            spec["nodes.snap_voters"] = U16
+    if cfg.narrow_ring:
+        spec["nodes.log_term"] = U16
+    if cfg.narrow_mailbox:
+        for n in _MB_U16:
+            spec[f"mailbox.{n}"] = U16
+        if cfg.prevote:
+            for n in _MB_PV_U16:
+                spec[f"mailbox.{n}"] = U16
+        if cfg.transfer_u32:
+            spec["mailbox.tn_term"] = U16
+        spec["mailbox.ae_req_n"] = I8
+        if cfg.k <= 16:
+            spec["mailbox.is_req_snap_voters"] = U16
+    if cfg.narrow_clients and cfg.clients_u32:
+        from raft_tpu.clients.state import NARROW_CLIENT_SPEC
+        spec["nodes.session_seq"] = I16
+        spec["nodes.snap_session_seq"] = I16
+        spec["mailbox.is_req_snap_sessions"] = I16
+        for n, dt in NARROW_CLIENT_SPEC.items():
+            spec[f"clients.{n}"] = dt
+    return spec
+
+
+def full_narrow_spec(cfg: RaftConfig) -> dict:
+    """The spec with every narrow dial forced on — the set of (name,
+    dtype) hops checkpoint.load accepts regardless of which dials the
+    writing run had (a dtype outside this map is a semantic mismatch
+    and still refuses)."""
+    return narrow_spec(dataclasses.replace(
+        cfg, narrow_scalars=True, narrow_ring=True, narrow_mailbox=True,
+        narrow_clients=True))
+
+
+def narrow_active(cfg: RaftConfig) -> bool:
+    """True iff the resident State form differs from the wide one (a
+    lone `narrow_clients` dial on a clients-off universe maps zero
+    leaves, so it is NOT active — the spec, not the flags, decides)."""
+    return bool(narrow_spec(cfg))
+
+
+def _map_named(tree, prefix, fn):
+    """Rebuild a NamedTuple pytree applying fn(dot_path, leaf) to every
+    non-None leaf — the iter_named_leaves naming rule, reconstructing."""
+    if tree is None:
+        return None
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):
+        return type(tree)(*(_map_named(getattr(tree, f), f"{prefix}{f}.",
+                                       fn) for f in tree._fields))
+    return fn(prefix[:-1], tree)
+
+
+def narrow_state(cfg: RaftConfig, st: State) -> State:
+    """Wide State -> the cfg's narrow resident form, latching bit 31 of
+    `group_id` for any group holding a value that does not survive the
+    round-trip (sticky: an already-latched group stays latched because
+    the unlatched lanes pass through `where` unchanged). Identity when
+    every narrow dial is off. Traceable — runs inside the jitted tick
+    boundary every tick."""
+    spec = narrow_spec(cfg)
+    if not spec:
+        return st
+    overflow = []
+
+    def leaf(name, a):
+        dt = spec.get(name)
+        if dt is None or a.dtype == dt:
+            return a
+        na = a.astype(dt)
+        bad = (na.astype(a.dtype) != a).reshape(a.shape[0], -1)
+        overflow.append(jnp.any(bad, axis=1))
+        return na
+
+    out = _map_named(st, "", leaf)
+    if not overflow:
+        return out
+    ov = overflow[0]
+    for b in overflow[1:]:
+        ov = ov | b
+    return out._replace(group_id=jnp.where(
+        ov, out.group_id | _NARROW_LATCH, out.group_id))
+
+
+def widen_state(cfg: RaftConfig, st: State) -> State:
+    """Narrow resident form -> the audited wide compute form (every
+    narrowed lane back at i32; zero-extend for the unsigned lanes,
+    sign-extend for the -1-sentinel ones). group_id passes through
+    unchanged — the latch must survive the round-trip. Identity when
+    every narrow dial is off."""
+    spec = narrow_spec(cfg)
+    if not spec:
+        return st
+
+    def leaf(name, a):
+        if name in spec and a.dtype != I32:
+            return a.astype(I32)
+        return a
+
+    return _map_named(st, "", leaf)
+
+
+def narrow_overflow(st: State) -> jnp.ndarray:
+    """bool[G]: groups whose narrow-dtype latch has fired."""
+    return st.group_id < 0
+
+
+def check_narrow_overflow(cfg: RaftConfig, st: State) -> None:
+    """The host-boundary refusal (checkpoint.save, pkernel.kfinish, the
+    run drivers): raise ValueError naming the latched groups — a term/
+    index/clock outgrew its narrow dtype, so every later value in those
+    groups is suspect. Mirrors pkernel._check_ring_overflow."""
+    if not narrow_active(cfg):
+        return
+    import numpy as np
+    ov = np.asarray(narrow_overflow(st))
+    if ov.any():
+        bad = np.nonzero(ov)[0]
+        raise ValueError(
+            f"narrow-dtype overflow latched in {len(bad)} group(s) "
+            f"(first: {bad[:8].tolist()}): a value outgrew its narrow "
+            f"native dtype (DESIGN.md §18 range table). Re-run with the "
+            f"narrow_* dials off — results after the latch tick are "
+            f"invalid and are refused rather than silently truncated")

@@ -974,7 +974,28 @@ def _filter_mailbox(cfg, mb: Mailbox, t, alive_now, group_id) -> Mailbox:
 def tick(cfg: RaftConfig, st: State, t) -> State:
     """One global tick over all [G, K] replicas: `Cluster.tick`
     (cluster.py:100) vectorized. `t` is the absolute tick counter (traced;
-    fault schedules hash it)."""
+    fault schedules hash it).
+
+    Narrow-native boundary (DESIGN.md §18): when any `narrow_*` dial is
+    on, the resident carry is the narrow form — the body widens every
+    narrowed lane back to the audited i32 widths on entry, computes the
+    UNCHANGED wide tick, and re-narrows on exit (latching group_id bit
+    31 on overflow). Dtype-stable for lax.scan by construction, and the
+    wide compute means tick semantics are byte-for-byte the r18 ones on
+    every engine — the dials move bytes, never logic."""
+    from raft_tpu.sim import state as state_mod
+    narrowing = state_mod.narrow_active(cfg)
+    if narrowing:
+        st = state_mod.widen_state(cfg, st)
+    out = _tick_wide(cfg, st, t)
+    if narrowing:
+        out = state_mod.narrow_state(cfg, out)
+    return out
+
+
+def _tick_wide(cfg: RaftConfig, st: State, t) -> State:
+    """The wide-i32 tick body — everything below this line is r18's
+    tick, untouched by the narrow dials."""
     g, k = st.alive_prev.shape
     g_grid = jnp.broadcast_to(st.group_id[:, None], (g, k))
     i_grid = jnp.broadcast_to(jnp.arange(k, dtype=I32)[None, :], (g, k))

@@ -18,18 +18,39 @@ def trees_equal(a, b) -> bool:
     return ok
 
 
-def leaf_mismatch(x, y) -> str | None:
+def trees_equal_values(a, b) -> bool:
+    """The values-only form: dtype-blind across integer/bool widths.
+    See trees_equal_why(values_only=True)."""
+    ok, _ = trees_equal_why(a, b, values_only=True)
+    return ok
+
+
+def leaf_mismatch(x, y, values_only: bool = False) -> str | None:
     """None when the two arrays are byte-identical; otherwise a one-line
     description carrying dtype, shape, the differing-element count, and
     the first differing index with both values — enough to aim a triage
-    bisection without re-running anything."""
+    bisection without re-running anything.
+
+    `values_only=True` is the narrow-dtype comparator mode (DESIGN.md
+    §18): integer/bool leaves compare by VALUE through an exact int64
+    lift, so a u16/i8 narrow-native leaf can be pinned against its
+    wide i32 oracle twin. Shape mismatches still fail, and a dtype
+    mismatch that is not an exact integer lift (e.g. float vs int)
+    still fails — the mode relaxes width, never meaning."""
     x, y = np.asarray(x), np.asarray(y)
     meta_x = f"{x.dtype}{list(x.shape)}"
     meta_y = f"{y.dtype}{list(y.shape)}"
     if x.shape != y.shape:
         return f"shape mismatch: {meta_x} vs {meta_y}"
     if x.dtype != y.dtype:
-        return f"dtype mismatch: {meta_x} vs {meta_y}"
+        int_like = all(np.issubdtype(d, np.integer)
+                       or np.issubdtype(d, np.bool_)
+                       for d in (x.dtype, y.dtype))
+        if not (values_only and int_like):
+            return f"dtype mismatch: {meta_x} vs {meta_y}"
+        # int64 holds every integer dtype in the repo exactly (widest
+        # lane is u32), so the lift never aliases two distinct values.
+        x, y = x.astype(np.int64), y.astype(np.int64)
     neq = x != y   # NaN != NaN — matches np.array_equal's default
     n_bad = int(np.count_nonzero(neq))
     if n_bad == 0:
@@ -49,19 +70,22 @@ def _label(path, n, names):
     return label or f"leaf {n}"
 
 
-def trees_equal_why(a, b, names=None):
+def trees_equal_why(a, b, names=None, values_only: bool = False):
     """(equal, why) — like `trees_equal`, but `why` names the FIRST
     divergent leaf by its pytree path (e.g. `.nodes.log_term` for a
     `State`) with its dtype/shape and first differing element, or the
     leaf-count mismatch. `names` (e.g. a NamedTuple's `_fields`)
     overrides the path labels when given — kept for callers that compare
-    bare leaf tuples with their own naming."""
+    bare leaf tuples with their own naming. `values_only=True` relaxes
+    integer/bool WIDTH only (the narrow-native differential mode, see
+    leaf_mismatch) — engine-to-engine gates at matching cfg keep the
+    default byte-strict mode."""
     pa, _ = jax.tree_util.tree_flatten_with_path(a)
     pb, _ = jax.tree_util.tree_flatten_with_path(b)
     if len(pa) != len(pb):
         return False, f"leaf count {len(pa)} != {len(pb)}"
     for n, ((path_x, x), (_, y)) in enumerate(zip(pa, pb)):
-        why = leaf_mismatch(x, y)
+        why = leaf_mismatch(x, y, values_only=values_only)
         if why is not None:
             return False, (f"first divergent leaf: "
                            f"{_label(path_x, n, names)} — {why}")

@@ -31,12 +31,29 @@ import numpy as np
 from raft_tpu.core.node import LEADER
 
 
+def _signed(a, xp=np):
+    """`a` lifted to a signed >= 32-bit lane when it is a narrow or
+    unsigned integer (r19, DESIGN.md §18): predicate arithmetic — the
+    ring-slot subtraction below, the window differences — must run at
+    the audited width regardless of the caller's resident dtype. At
+    u16, `s - snap` wraps to the 65-thousands and the `off >= 0` branch
+    is vacuously true, silently blessing a broken window. Bools and
+    already-wide signed lanes pass through untouched, so the wide path
+    is byte-for-byte the pre-r19 one; int64 under numpy (the model
+    checker's native view width), int32 under jax (x64 is off)."""
+    dt = np.dtype(a.dtype)
+    if dt == np.bool_ or (dt.kind == "i" and dt.itemsize >= 4):
+        return a
+    return a.astype(np.int64 if xp is np else np.int32)
+
+
 def slot_abs_index(snap_index, log_cap: int, xp=np):
     """`[..., L]` absolute index assigned to each ring slot: entry at
     absolute index i lives in slot (i-1) % L on EVERY node, so slot s
     under window (snap, snap+L] holds snap + 1 + ((s - snap) mod L) —
     the same formula as `step._abs_index` / `pkernel._abs_index`,
     written without a negative-operand mod."""
+    snap_index = _signed(snap_index, xp)
     s = xp.arange(log_cap, dtype=snap_index.dtype)
     off = s - snap_index[..., None] % log_cap
     return snap_index[..., None] + 1 + xp.where(off >= 0, off,
@@ -74,6 +91,8 @@ def window_bounds(applied, commit, snap_index, last_index, log_cap: int,
                   xp=np):
     """Per-node structural sanity: applied == commit (phase A drains),
     snap <= commit <= last, window within the ring capacity."""
+    applied, commit, snap_index, last_index = (
+        _signed(a, xp) for a in (applied, commit, snap_index, last_index))
     ok = ((applied == commit)
           & (snap_index <= commit) & (commit <= last_index)
           & (last_index - snap_index <= log_cap))
@@ -87,6 +106,7 @@ def log_matching(last_index, snap_index, log_term, log_payload,
     point-in-time, per overlapping ring lane). Slot identity makes the
     pairwise compare elementwise: slot s holds the same absolute index
     on both nodes exactly when their computed slot indices agree."""
+    last_index = _signed(last_index, xp)
     k = last_index.shape[-1]
     ok = xp.ones(last_index.shape[:-1], dtype=bool)
     absidx = slot_abs_index(snap_index, log_cap, xp)      # [..., K, L]
@@ -122,6 +142,8 @@ def leader_completeness(role, term, commit, last_index, snap_index,
     Entries below a's snap_index are excluded structurally (slot
     indices live in (snap_a, snap_a + L]); b's restart rewind only
     shrinks commit_b, weakening nothing."""
+    commit = _signed(commit, xp)
+    last_index = _signed(last_index, xp)
     k = role.shape[-1]
     ok = xp.ones(role.shape[:-1], dtype=bool)
     absidx = slot_abs_index(snap_index, log_cap, xp)      # [..., K, L]
@@ -146,6 +168,8 @@ def client_safety(applied, session_seq, done, xp=np):
     same applied prefix hold element-identical (sid -> seq) dedup
     tables, and no table entry exceeds the slot's issued frontier.
     `session_seq` is `[..., K, S]`, `done` is `[..., S]`."""
+    session_seq = _signed(session_seq, xp)
+    done = _signed(done, xp)
     k = session_seq.shape[-2]
     ok = xp.all(session_seq <= done[..., None, :], axis=(-2, -1))
     for a in range(k):

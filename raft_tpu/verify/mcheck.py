@@ -77,6 +77,17 @@ HUGE_DEADLINE = 1 << 30
 ARTIFACT_KIND = "mcheck-reproducer"
 ARTIFACT_ENGINES = "oracle-mcheck"
 
+#: The r19 narrow-native dtype map over the checker's view names
+#: (DESIGN.md §18) — the mcheck twin of sim/state.narrow_spec's
+#: nodes.* entries, used by `predicate_report(narrow=True)` to prove
+#: the shared predicates are width-robust over the exhaustive small
+#: scope. Payload/digest lanes stay wide, exactly as the spec keeps
+#: them resident-wide.
+_NARROW_VIEW_DTYPES = {"role": np.int8, "term": np.uint16,
+                       "commit": np.uint16, "applied": np.uint16,
+                       "snap_index": np.uint16, "last_index": np.uint16,
+                       "log_term": np.uint16}
+
 
 @dataclasses.dataclass(frozen=True)
 class Bounds:
@@ -140,8 +151,14 @@ class Universe:
     """k real `Node`s + a real `Transport` under scheduler control,
     with freeze/restore so BFS can fan out from any state."""
 
-    def __init__(self, bounds: Bounds, node_cls=Node):
+    def __init__(self, bounds: Bounds, node_cls=Node,
+                 narrow: bool = False):
         self.bounds = bounds
+        # narrow=True evaluates every predicate on narrow-native views
+        # (_NARROW_VIEW_DTYPES) — the r19 kill-matrix re-run mode: a
+        # mutant must die, and the clean oracle must survive, at BOTH
+        # widths or the _signed lifts are wrong.
+        self.narrow = narrow
         self.cfg = bounds_config(bounds)
         self.transport = Transport(self.cfg, 0)
         self.nodes = [node_cls(self.cfg, 0, i, self.transport,
@@ -345,11 +362,24 @@ class Universe:
         v["log_term"], v["log_payload"] = lt, lp
         return v
 
-    def predicate_report(self) -> dict:
+    def predicate_report(self, narrow: bool = False) -> dict:
         """name -> bool: the verify/invariants predicates (the clause
         registry sim/check.py folds, plus log_matching which the
-        runtime approximates via digest agreement) on this state."""
+        runtime approximates via digest agreement) on this state.
+
+        `narrow=True` evaluates the SAME predicates on views cast to
+        the r19 narrow-native dtypes (sim/state.narrow_spec's map —
+        u16 terms/indices, i8 roles, i16 session tables; DESIGN.md
+        §18): at bounded-model scope every value fits, so the two
+        reports must be identical — `narrow_agreement_problems` walks
+        the small universe asserting exactly that, which is how the
+        width-robustness of verify/invariants (its `_signed` lifts) is
+        proven against the exhaustive state space rather than one
+        hand-picked example."""
         cfg, v = self.cfg, self.views()
+        if narrow:
+            v = {name: a.astype(_NARROW_VIEW_DTYPES.get(name, a.dtype))
+                 for name, a in v.items()}
         rep = {
             "election_safety": inv.election_safety(v["role"], v["term"]),
             "digest_agreement": inv.digest_agreement(v["applied"],
@@ -368,12 +398,19 @@ class Universe:
             table = np.array([[[n.sessions.get(0, -1)]
                                for n in self.nodes]])      # [1, K, 1]
             done = np.array([[self.issued]])               # [1, 1]
+            if narrow:
+                # i16 both: the spec's table dtype, and — for `done` —
+                # the sign-preserving width, because the mcheck frontier
+                # uses a -1 "nothing issued" sentinel the resident u16
+                # lane never stores (ClientState.done is a count).
+                table, done = table.astype(np.int16), done.astype(np.int16)
             rep["client_safety"] = inv.client_safety(
                 v["applied"], table, done)
         return {name: bool(np.all(ok)) for name, ok in rep.items()}
 
     def violations(self) -> List[str]:
-        return [name for name, ok in self.predicate_report().items()
+        return [name for name, ok
+                in self.predicate_report(narrow=self.narrow).items()
                 if not ok]
 
     def in_bounds(self) -> bool:
@@ -535,7 +572,7 @@ class Result:
 
 
 def check(bounds: Bounds, node_cls=Node, log: Callable = None,
-          prefix: tuple = ()) -> Result:
+          prefix: tuple = (), narrow: bool = False) -> Result:
     """BFS over the canonicalized reachable states. Every state at
     every depth is checked against the shared predicates + history
     ghosts; the first violation wins and carries its full scheduler
@@ -547,8 +584,10 @@ def check(bounds: Bounds, node_cls=Node, log: Callable = None,
     fans out exhaustively for the remaining `bounds.ticks - len(prefix)`
     levels (guided model checking). The emitted counterexample contains
     the prefix, so the artifact is still one complete, replayable
-    schedule; clean-verification runs use no prefix."""
-    uni = Universe(bounds, node_cls)
+    schedule; clean-verification runs use no prefix. `narrow=True`
+    evaluates the predicates on narrow-native views (r19, DESIGN.md
+    §18) — the kill matrix must reproduce at both widths."""
+    uni = Universe(bounds, node_cls, narrow=narrow)
     root = uni.freeze()
     seen = {canonical(root, bounds.k)}
     frontier = [(root, ())]     # (raw state, schedule that reached it)
@@ -574,7 +613,7 @@ def check(bounds: Bounds, node_cls=Node, log: Callable = None,
                 transitions += 1
                 if viol:
                     try:
-                        report = uni.predicate_report()
+                        report = uni.predicate_report(narrow=uni.narrow)
                     except Exception:
                         report = {}   # mid-assert state may not view
                     return Result(
@@ -830,6 +869,56 @@ def replay(art: dict, node_cls=None) -> dict:
                          "reproduce")
 
 
+# ----------------------------------------------- narrow-width agreement
+
+
+def narrow_agreement_problems(ticks: int = 2, max_states: int = 250,
+                              sessions: bool = False) -> list[str]:
+    """Walk the k=2 small-scope universe (depth `ticks`, up to
+    `max_states` states) asserting `predicate_report()` and
+    `predicate_report(narrow=True)` return IDENTICAL verdicts at every
+    visited state — the r19 proof that verify/invariants' predicates
+    hold at the narrow-native widths (their `_signed` lifts work) over
+    an exhaustive state space, not one example. Returns problem
+    strings (empty = agreement everywhere); wired into `smoke` and the
+    auditor's narrowing pass."""
+    b = Bounds(k=2, ticks=ticks, max_states=max_states, sessions=sessions)
+    uni = Universe(b)
+    problems: list[str] = []
+    seen = 0
+
+    def walk(depth: int, t: int):
+        nonlocal seen
+        if problems or seen >= max_states:
+            return
+        seen += 1
+        wide = uni.predicate_report()
+        narrow = uni.predicate_report(narrow=True)
+        if wide != narrow:
+            diff = {k: (wide[k], narrow[k]) for k in wide
+                    if wide[k] != narrow.get(k)}
+            problems.append(
+                f"narrow-width predicate disagreement at depth "
+                f"{ticks - depth}: wide vs narrow {diff}")
+            return
+        if depth == 0:
+            return
+        frozen = uni.freeze()
+        for choice in list(uni.choices()):
+            uni.restore(frozen)
+            try:
+                uni.tick(t, choice)
+            except AssertionError:
+                continue   # pruned oracle path; agreement is the question
+            walk(depth - 1, t + 1)
+            if problems or seen >= max_states:
+                break
+        uni.restore(frozen)
+
+    walk(ticks, 0)
+    return problems
+
+
 # ------------------------------------------------------------- the smoke
 
 
@@ -843,6 +932,17 @@ def smoke(ticks: int = 3, max_states: int = 1500) -> Result:
     res = check(b)
     if not res.ok:
         return res
+    # r19: the shared predicates must report identically on wide and
+    # narrow-native views over the explored scope (DESIGN.md §18).
+    nw = narrow_agreement_problems(ticks=2, max_states=250)
+    if nw:
+        return Result(ok=False, states=res.states,
+                      transitions=res.transitions, depth=res.depth,
+                      complete=res.complete, pruned=res.pruned,
+                      violation={"tick": -1,
+                                 "predicates": ["narrow_disagreement"],
+                                 "schedule": [],
+                                 "report": {"narrow": nw[:4]}})
     from raft_tpu.verify import mutants
     canary = mutants.by_name("minority_quorum")
     kill = check(Bounds(k=2, ticks=2, max_states=max_states,

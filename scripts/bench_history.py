@@ -10,6 +10,16 @@ this prints the r01->r05 trajectory and `--check --threshold 0.15`
 flags the r02->r04 XLA throughput fade (7.18M -> 5.07M rounds/s); the
 driver gets a real perf gate instead of an unread pile of JSON.
 
+With --baseline FILE (r19: the pre-push wiring in scripts/ci_static.sh
+passes scripts/bench_baseline.json), known regressions are an
+ALLOWLIST, not a pass: a series already recorded in the baseline only
+fails the check when its drop deepens more than BASELINE_SLACK_PCT
+beyond the recorded figure — so the historical r02->r04 fade stays
+visible in the table but does not wedge the gate shut, while any NEW
+regression (a series the baseline has never seen, or a known fade
+getting worse) still exits 2. Regenerate the file with
+--write-baseline after knowingly accepting a trade-off.
+
 No jax import, no device, no compile — pure file parsing, safe
 anywhere (including the tier-1 test tier, tests/test_perf_obs.py).
 """
@@ -25,6 +35,32 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from raft_tpu.obs import history  # noqa: E402
+
+# A baselined regression may deepen this many percentage POINTS past
+# its recorded drop_pct before it counts as new again — absorbs
+# measurement jitter between hosts without letting a real further
+# slide hide behind the allowlist.
+BASELINE_SLACK_PCT = 1.0
+
+
+def _reg_key(r: dict) -> str:
+    """Stable identity of a regressing series in the baseline file."""
+    return f"{r['segment']}|{r['engine']}|{r['unit']}"
+
+
+def split_known(regs: list, baseline: dict) -> tuple[list, list]:
+    """(new, known): a regression is KNOWN iff the baseline records its
+    series and the drop has not deepened past the recorded figure plus
+    BASELINE_SLACK_PCT."""
+    new, known = [], []
+    for r in regs:
+        rec = baseline.get(_reg_key(r))
+        if rec is not None and \
+                r["drop_pct"] <= rec["drop_pct"] + BASELINE_SLACK_PCT:
+            known.append(r)
+        else:
+            new.append(r)
+    return new, known
 
 
 def main(argv=None) -> int:
@@ -43,6 +79,14 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the normalized rows + regressions as JSON "
                          "instead of the table")
+    ap.add_argument("--baseline", default=None,
+                    help="JSON allowlist of known regressions; with "
+                         "--check only NEW regressions (or known ones "
+                         f"deepening > {BASELINE_SLACK_PCT} pt past their "
+                         "recorded drop) exit 2")
+    ap.add_argument("--write-baseline", default=None, metavar="FILE",
+                    help="record the current regressions as the new "
+                         "baseline allowlist and exit 0")
     args = ap.parse_args(argv)
 
     rows = history.load_history(args.root, manifest=args.manifest)
@@ -51,14 +95,38 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
     regs = history.regressions(rows, threshold=args.threshold)
+    if args.write_baseline:
+        base = {_reg_key(r): {"drop_pct": r["drop_pct"],
+                              "best": r["best"], "latest": r["latest"],
+                              "best_source": r["best_source"],
+                              "latest_source": r["latest_source"]}
+                for r in regs}
+        with open(args.write_baseline, "w") as f:
+            json.dump({"threshold": args.threshold,
+                       "slack_pct": BASELINE_SLACK_PCT,
+                       "known": base}, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {len(base)} known regression(s) to "
+              f"{args.write_baseline}", file=sys.stderr)
+        return 0
+    baseline = {}
+    if args.baseline:
+        with open(args.baseline) as f:
+            baseline = json.load(f)["known"]
+    new, known = split_known(regs, baseline)
     if args.json:
-        print(json.dumps({"rows": rows, "regressions": regs}, indent=1))
+        print(json.dumps({"rows": rows, "regressions": regs,
+                          "new_regressions": new}, indent=1))
     else:
         print(history.trend_table(rows))
         print(f"{len(rows)} points across "
               f"{len(history.series(rows))} series")
-    if regs:
-        for r in regs:
+    for r in known:
+        print(f"known regression (baselined): {r['segment']} "
+              f"[{r['engine']}] -{r['drop_pct']}% vs best ancestor",
+              file=sys.stderr)
+    if new:
+        for r in new:
             print(f"REGRESSION: {r['segment']} [{r['engine']}] "
                   f"{r['latest']:,.1f} {r['unit']} ({r['latest_source']}) "
                   f"is -{r['drop_pct']}% vs best ancestor "
@@ -67,8 +135,9 @@ def main(argv=None) -> int:
         if args.check:
             return 2
     elif args.check:
-        print(f"regression check clean at threshold {args.threshold}",
-              file=sys.stderr)
+        extra = f" ({len(known)} baselined)" if known else ""
+        print(f"regression check clean at threshold {args.threshold}"
+              f"{extra}", file=sys.stderr)
     return 0
 
 

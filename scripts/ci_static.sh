@@ -9,13 +9,19 @@
 #   - depth-limited bounded model-checker smoke (exhaustive clean
 #     oracle at tiny scope + a seeded-mutant canary kill),
 #   - stream-scheduler hazard prover (real r16/r17 pipelines over the
-#     bound grid + synthetic negatives caught with file:line).
+#     bound grid + synthetic negatives caught with file:line),
+#   - bench-history regression gate (r19): the checked-in perf
+#     trajectory vs scripts/bench_baseline.json — known fades are
+#     allowlisted, any NEW regression (or a known one deepening) fails.
 #
-# All four are `static_audit --level deep` (analysis/cli.py); rc != 0
-# names the violated contract/invariant. Run before pushing:
+# The first four are `static_audit --level deep` (analysis/cli.py);
+# rc != 0 names the violated contract/invariant/regression. Run before
+# pushing:
 #
 #   scripts/ci_static.sh
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+python scripts/bench_history.py --check --threshold 0.15 \
+    --baseline scripts/bench_baseline.json >/dev/null
 exec python scripts/static_audit.py --level deep "$@"

@@ -319,6 +319,113 @@ def ablation_table(measure: bool, groups: int, ticks: int):
           f"{full / r12:.2f}x the modeled single-chip ceiling")
 
 
+# The r19 narrow-native ablation (DESIGN.md §18): cumulative dial
+# rows — each row's resident delta against the previous is that dial's
+# price. donate_scan rides last: it halves scan RESIDENCY buffers, not
+# bytes/group, so its row moves the "x res" column only.
+NARROW_ABLATION_ROWS = (
+    ("wide (r18 resident)", {}),
+    ("+narrow_scalars", dict(narrow_scalars=True)),
+    ("+narrow_ring", dict(narrow_scalars=True, narrow_ring=True)),
+    ("+narrow_mailbox", dict(narrow_scalars=True, narrow_ring=True,
+                             narrow_mailbox=True)),
+    ("+narrow_clients", dict(narrow_scalars=True, narrow_ring=True,
+                             narrow_mailbox=True, narrow_clients=True)),
+    ("+donate_scan (all dials)", dict(narrow_scalars=True,
+                                      narrow_ring=True,
+                                      narrow_mailbox=True,
+                                      narrow_clients=True,
+                                      donate_scan=True)),
+)
+
+
+def _measure_xla_ticks_per_sec(cfg, n_groups: int, ticks: int) -> float:
+    """Steady-state XLA-scan ticks/s under `cfg`'s narrow dials —
+    CPU-honest: runs on whatever backend is attached and the table
+    labels the platform, because the narrow claim here is "no tick-rate
+    cliff from the boundary casts", which a CPU box can falsify."""
+    from raft_tpu import sim
+    from raft_tpu.sim.run import metrics_init, run
+
+    cl = bool(cfg.clients_u32)
+    st = sim.init(cfg, n_groups=n_groups)
+    m = metrics_init(n_groups, clients=cl)
+    st, m = run(cfg, st, ticks, metrics=m)          # compile + warm
+    jax.block_until_ready(st)
+    best = float("inf")
+    for _ in range(3):
+        st2 = sim.init(cfg, n_groups=n_groups)
+        m2 = metrics_init(n_groups, clients=cl)
+        t0 = time.perf_counter()
+        st2, m2 = run(cfg, st2, ticks, metrics=m2)
+        jax.block_until_ready(st2)
+        best = min(best, time.perf_counter() - t0)
+    return ticks / best
+
+
+def narrow_ablation_table(measure: bool, groups: int, ticks: int):
+    """The r19 native-dtype column of --ablate (DESIGN.md §18):
+    per-dial RESIDENT bytes/group (the XLA scan carry — the kernel
+    wire is dial-invariant and stays in the r13 table above), the
+    derived-vs-pinned verdict from the four-way reconciled byte model,
+    the per-leaf wide-vs-narrow table, and a measured XLA ticks/s
+    column (CPU-honest: labeled with the attached platform)."""
+    import dataclasses
+
+    from raft_tpu.analysis import bytemodel
+    from raft_tpu.config import RaftConfig
+    from raft_tpu.obs.roofline import tick_byte_model
+
+    cbase = dataclasses.replace(RaftConfig(seed=42), sessions=True,
+                                cmds_per_tick=0, client_rate=0.2,
+                                client_slots=4, client_retry_backoff=8)
+    platform = jax.devices()[0].platform
+    print(f"narrow-native resident ablation (DESIGN.md §18; XLA scan "
+          f"carry, flight off; measured on {platform}, "
+          f"G={groups:,}, {ticks} ticks):")
+    print(f"  {'dials':28s} {'resident B/g':>12s} {'cut':>7s} "
+          f"{'x res':>5s} {'measured ticks/s':>16s}")
+    prev = None
+    for label, knobs in NARROW_ABLATION_ROWS:
+        # All rows ride the clients universe so the cumulative deltas
+        # stay additive through the +narrow_clients row; the headline
+        # (clients-off) pair prints in the verdict line below.
+        cfg = dataclasses.replace(cbase, **knobs)
+        model = bytemodel.resident_bytes_model(cfg)
+        resident = model["resident_bytes_narrow"]
+        cut = f"-{model['reduction_pct']:.1f}%"
+        measured = "-"
+        if measure:
+            try:
+                tps = _measure_xla_ticks_per_sec(cfg, groups, ticks)
+                measured = f"{tps:,.1f}"
+            except Exception as e:   # a row must never kill the table
+                measured = f"error: {type(e).__name__}"
+        note = ""
+        if prev is not None and resident != prev:
+            note = f"  (-{prev - resident} B)"
+        xres = tick_byte_model(cfg, groups, "xla",
+                               with_flight=False)["scan_residency_buffers"]
+        print(f"  {label:28s} {resident:12,d} {cut:>7s} "
+              f"{xres:>5d} {measured:>16s}{note}")
+        prev = resident
+    probs = bytemodel.narrow_model_problems()
+    verdict = ("derived == pinned (4034 -> 2494 headline, 4734 -> 2842 "
+               "clients; all four accountings agree)" if not probs
+               else "; ".join(probs))
+    print(f"  narrow byte model verdict: {verdict}")
+    ncfg = bytemodel.all_dials_cfg(cbase)
+    model = bytemodel.resident_bytes_model(ncfg)
+    narrowed = [r for r in model["leaves"] if r["narrowed"]]
+    print(f"  per-leaf wide -> narrow (clients universe, "
+          f"{len(narrowed)} leaves narrowed):")
+    for r in sorted(narrowed, key=lambda r: r["bytes_wide"]
+                    - r["bytes_narrow"], reverse=True):
+        print(f"    {r['bytes_wide']:5d} -> {r['bytes_narrow']:4d} B  "
+              f"{r['name']:32s} {r['dtype_wide']} -> {r['dtype_narrow']}"
+              f"{r['shape_per_group']}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--bytes-only", action="store_true",
@@ -363,6 +470,9 @@ def main():
         return
     if args.ablate:
         ablation_table(True, args.ablate_groups, args.ablate_ticks)
+        print()
+        narrow_ablation_table(True, min(args.ablate_groups, 4096),
+                              min(args.ablate_ticks, 64))
         return
     bytes_per_group_report()
     if args.bytes_only:

@@ -1,0 +1,400 @@
+"""The r19 narrow-native layout gates (DESIGN.md §18).
+
+Three families:
+- identity: every narrow dial off is byte-identical to the r18 layout
+  (wire pins, config_hash, init bytes), and every dial on is
+  VALUE-identical to the wide oracle chain across XLA scan and Pallas
+  kernel on the shared faulted universes (the wide XLA path is already
+  pinned bit-identical to the CPU oracle by test_differential, so
+  values-equal-to-wide-XLA is values-equal-to-the-oracle);
+- boundaries: the sticky bit-31 group_id latch fires on overflow, is
+  refused loudly at every host boundary (checkpoint.save, the stream
+  drivers), and checkpoints hop the narrow axis both ways BY NAME;
+- verification: the model-checker kill matrix reproduces at narrow
+  widths, and the comparator/lint seams behave.
+
+Narrow dials re-declare RESIDENT dtypes only — the kernel wire and the
+compiled programs are dial-invariant, so every kernel test here reuses
+the shared-universe compile cache (conftest recipe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+
+import numpy as np
+import pytest
+
+import conftest  # noqa: F401  (pins the CPU platform before jax loads)
+import jax.numpy as jnp
+
+from raft_tpu.config import NARROW_FIELDS, RaftConfig
+from raft_tpu.parallel.kmesh import faulted_64_cfg
+from raft_tpu.sim import checkpoint, pkernel, state
+from raft_tpu.sim.run import metrics_init, run
+from raft_tpu.utils.trees import (trees_equal, trees_equal_values,
+                                  trees_equal_why)
+
+ALL_DIALS = {f: True for f in NARROW_FIELDS}
+NO_DIALS = {f: False for f in NARROW_FIELDS}
+
+
+def _narrow_faulted():
+    return faulted_64_cfg(**ALL_DIALS)
+
+
+# ------------------------------------------------------- dials-off = r18
+
+
+def test_dials_off_byte_identity():
+    """Every dial off: empty dtype map, identical init bytes, identical
+    wire pins (8,308 / 11,056 / 3,552 B/group), identical config_hash —
+    the r18 layout IS the default."""
+    from raft_tpu.obs.manifest import config_hash
+
+    cfg = faulted_64_cfg()
+    off = faulted_64_cfg(**NO_DIALS)
+    assert state.narrow_spec(cfg) == {}
+    assert not state.narrow_active(cfg)
+    assert trees_equal(state.init(cfg), state.init(off))
+    assert config_hash(cfg) == config_hash(faulted_64_cfg(**ALL_DIALS))
+
+    headline = RaftConfig(seed=42)
+    clients = dataclasses.replace(headline, sessions=True, cmds_per_tick=0,
+                                  client_rate=0.2, client_slots=4,
+                                  client_retry_backoff=8)
+    packed = dataclasses.replace(headline, pack_bools=True, pack_ring=True,
+                                 alias_wire=True, wire_hist=False)
+    for base, pin, wf in ((headline, 8308, True), (clients, 11056, True),
+                          (packed, 3552, False)):
+        narrow = dataclasses.replace(base, **ALL_DIALS)
+        assert 4 * pkernel.wire_words_per_group(base, with_flight=wf) == pin
+        assert 4 * pkernel.wire_words_per_group(narrow,
+                                                with_flight=wf) == pin
+
+
+def test_resident_pins_and_floor():
+    """The four-way reconciled resident model, pinned exactly: headline
+    4,034 -> 2,494 B/group (-38.2%), clients 4,734 -> 2,842 (-40.0%),
+    both over the >= 35% r19 floor."""
+    from raft_tpu.analysis import bytemodel
+
+    assert bytemodel.narrow_model_problems() == []
+    m = bytemodel.resident_bytes_model(
+        bytemodel.all_dials_cfg(bytemodel.headline_cfg()))
+    assert (m["resident_bytes_wide"], m["resident_bytes_narrow"]) \
+        == (4034, 2494)
+    assert m["reduction_pct"] >= 35.0
+    c = bytemodel.resident_bytes_model(
+        bytemodel.all_dials_cfg(bytemodel.clients_cfg()))
+    assert (c["resident_bytes_wide"], c["resident_bytes_narrow"]) \
+        == (4734, 2842)
+    assert c["reduction_pct"] >= 35.0
+
+
+def test_init_dtypes_follow_spec():
+    """The real narrow init lands exactly on narrow_spec's dtypes, and
+    every unlisted leaf stays wide."""
+    from raft_tpu.sim.checkpoint import iter_named_leaves
+
+    cfg = _narrow_faulted()
+    spec = state.narrow_spec(cfg)
+    assert spec
+    st = state.init(cfg)
+    wide = state.init(faulted_64_cfg())
+    for (name, leaf), (_, wleaf) in zip(iter_named_leaves(st),
+                                        iter_named_leaves(wide)):
+        want = spec.get(name, wleaf.dtype)
+        assert leaf.dtype == want, (name, leaf.dtype, want)
+
+
+# ----------------------------------------- narrow-on engine value parity
+
+
+def test_narrow_xla_value_identity_faulted():
+    """THE r19 XLA gate: the narrow scan (all dials) stays
+    value-identical to the wide run on full State AND full Metrics over
+    the faulted universe — and really is narrower (strict compare
+    fails on dtype)."""
+    ncfg, wcfg = _narrow_faulted(), faulted_64_cfg()
+    stw, mw = run(wcfg, state.init(wcfg), 48, 0, metrics_init(64))
+    stn, mn = run(ncfg, state.init(ncfg), 48, 0, metrics_init(64))
+    ok, why = trees_equal_why(stw, stn, values_only=True)
+    assert ok, why
+    ok, why = trees_equal_why(mw, mn, values_only=True,
+                              names=list(type(mw)._fields))
+    assert ok, why
+    assert not trees_equal(stw, stn)   # the dtypes really moved
+
+
+def test_narrow_kernel_value_identity_faulted():
+    """THE r19 kernel gate: the fused-chunk kernel under the narrow cfg
+    (kinit widens the lanes, the chunk computes wide, kfinish
+    re-narrows) stays value-identical to the wide XLA run — across two
+    launches so the re-entry boundary runs. The compiled program is
+    dial-invariant, so this reuses the shared faulted-universe
+    compile."""
+    ncfg, wcfg = _narrow_faulted(), faulted_64_cfg()
+    stw, mw = run(wcfg, state.init(wcfg), 48, 0, metrics_init(64))
+    leaves, g = pkernel.kinit(ncfg, state.init(ncfg))
+    leaves = pkernel.kstep(ncfg, leaves, 0, 24, interpret=True)
+    leaves = pkernel.kstep(ncfg, leaves, 24, 24, interpret=True)
+    stn, mn = pkernel.kfinish(ncfg, leaves, g)
+    ok, why = trees_equal_why(stw, stn, values_only=True)
+    assert ok, why
+    ok, why = trees_equal_why(mw, mn, values_only=True,
+                              names=list(type(mw)._fields))
+    assert ok, why
+    # And the kernel's own narrow round-trip landed on the narrow form.
+    spec = state.narrow_spec(ncfg)
+    assert str(stn.nodes.term.dtype) == str(np.dtype(spec["nodes.term"]))
+
+
+@pytest.mark.slow
+def test_narrow_clients_value_identity():
+    """The clients universe (sessions + dedup tables + ClientState)
+    under all dials: value-identical to wide on full State+Metrics."""
+    from raft_tpu.clients.workload import clients_64_cfg
+
+    ncfg = clients_64_cfg(**ALL_DIALS)
+    wcfg = clients_64_cfg()
+    stw, mw = run(wcfg, state.init(wcfg), 48, 0,
+                  metrics_init(64, clients=True))
+    stn, mn = run(ncfg, state.init(ncfg), 48, 0,
+                  metrics_init(64, clients=True))
+    ok, why = trees_equal_why(stw, stn, values_only=True)
+    assert ok, why
+    ok, why = trees_equal_why(mw, mn, values_only=True,
+                              names=list(type(mw)._fields))
+    assert ok, why
+    assert stn.clients.done.dtype == jnp.uint16
+    assert stn.clients.last_lat.dtype == jnp.int16
+
+
+def test_donation_twin_bit_identical():
+    """cfg.donate_scan routes through the donating jit twin and must be
+    a pure residency decision: bit-identical State+Metrics, on both the
+    wide and the narrow layout. Donated operands are stale after the
+    call — fresh inits per run, exactly the contract run() documents."""
+    wcfg = faulted_64_cfg()
+    dcfg = faulted_64_cfg(donate_scan=True)
+    stw, mw = run(wcfg, state.init(wcfg), 48, 0, metrics_init(64))
+    std, md = run(dcfg, state.init(dcfg), 48, 0, metrics_init(64))
+    assert trees_equal(stw, std)
+    assert trees_equal(mw, md)
+    ncfg = _narrow_faulted()
+    ndcfg = faulted_64_cfg(**{**ALL_DIALS, "donate_scan": True})
+    stn, mn = run(ncfg, state.init(ncfg), 48, 0, metrics_init(64))
+    stnd, mnd = run(ndcfg, state.init(ndcfg), 48, 0, metrics_init(64))
+    assert trees_equal(stn, stnd)
+    assert trees_equal(mn, mnd)
+    # No metrics operand -> nothing to donate; the twin must not engage.
+    st2 = run(ndcfg, state.init(ndcfg), 4)[0]
+    assert st2.nodes.term.dtype == jnp.uint16
+
+
+# ------------------------------------------------ overflow latch + hops
+
+
+def _latched(cfg):
+    """A narrow state with group 0's overflow latch forced on."""
+    st = state.init(cfg)
+    gid = np.asarray(st.group_id).copy()
+    gid[0] = np.int32(gid[0] | np.int32(-(2 ** 31)))
+    return st._replace(group_id=jnp.asarray(gid))
+
+
+def test_overflow_latches_sticky_and_refused():
+    """A wide value out of its narrow range latches bit 31 of group_id
+    for THAT group only; the latch survives widen/narrow round-trips
+    and further ticks; checkpoint.save refuses it loudly."""
+    cfg = _narrow_faulted()
+    wide = state.widen_state(cfg, state.init(cfg))
+    term = np.asarray(wide.nodes.term).copy()
+    term[3, 0] = 1 << 16                       # over u16, group 3 only
+    bad = wide._replace(nodes=wide.nodes._replace(term=jnp.asarray(term)))
+    narrowed = state.narrow_state(cfg, bad)
+    ov = np.asarray(state.narrow_overflow(narrowed))
+    assert ov[3] and not ov[:3].any() and not ov[4:].any()
+    with pytest.raises(ValueError, match="narrow-dtype overflow"):
+        state.check_narrow_overflow(cfg, narrowed)
+    # Sticky through the per-tick boundary and through clean data.
+    again = state.narrow_state(cfg, state.widen_state(cfg, narrowed))
+    assert np.asarray(state.narrow_overflow(again))[3]
+    stepped = run(cfg, narrowed, 2)[0]
+    assert np.asarray(state.narrow_overflow(stepped))[3]
+    buf = io.BytesIO()
+    with pytest.raises(ValueError, match="narrow-dtype overflow"):
+        checkpoint.save(buf, narrowed, 7, cfg=cfg)
+
+
+def test_stream_drivers_refuse_latched_state():
+    """The r19 host boundary on the paging drivers: a latched state is
+    refused at ENTRY (before any paging or compile), not after n_ticks
+    of garbage."""
+    from raft_tpu.parallel import cohort, kmesh, make_mesh
+
+    cfg = _narrow_faulted()
+    bad = _latched(cfg)
+    with pytest.raises(ValueError, match="narrow-dtype overflow"):
+        cohort.prun_streamed(cfg, bad, 8)
+    mesh = make_mesh(1)
+    with pytest.raises(ValueError, match="narrow-dtype overflow"):
+        kmesh.prun_sharded(cfg, bad, 8, mesh)
+    with pytest.raises(ValueError, match="narrow-dtype overflow"):
+        cohort.prun_streamed_sharded(cfg, bad, 8, mesh)
+
+
+def test_paged_wire_stays_word_sized():
+    """The scheduler's staging pool refuses a narrow dtype on the host
+    wire — the wire is i32/u32 words by contract, dials or not."""
+    from raft_tpu.parallel import stream_sched
+
+    cfg = _narrow_faulted()
+    leaves, g = pkernel.kinit(cfg, state.init(cfg))
+    host = tuple(np.asarray(a) for a in leaves)
+    assert stream_sched.wire_word_problems(host) == []
+    bad = (host[0].astype(np.int16),) + host[1:]
+    assert stream_sched.wire_word_problems(bad)
+    with pytest.raises(ValueError, match="narrow dtype on the paged"):
+        stream_sched.StagingPool(bad, pkernel.GB // 128)
+
+
+def test_checkpoint_hops_narrow_axis_by_name(tmp_path):
+    """A checkpoint written under one narrow layout loads under any
+    other BY NAME: values exact, dtypes landing on the destination
+    cfg's resident form, both directions — and a latched source never
+    reaches disk (covered above), while an out-of-range WIDE checkpoint
+    refuses at narrow load."""
+    ncfg, wcfg = _narrow_faulted(), faulted_64_cfg()
+    stw, _ = run(wcfg, state.init(wcfg), 24, 0, metrics_init(64))
+    p = tmp_path / "wide.npz"
+    checkpoint.save(str(p), stw, 24, cfg=wcfg)
+    stn, t, _ = checkpoint.load(str(p), cfg=ncfg)
+    assert t == 24
+    assert trees_equal_values(stw, stn)
+    assert trees_equal(stn, state.narrow_state(ncfg, stw))
+    # ... and back: narrow save -> wide load.
+    p2 = tmp_path / "narrow.npz"
+    checkpoint.save(str(p2), stn, 24, cfg=ncfg)
+    stw2, t2, _ = checkpoint.load(str(p2), cfg=wcfg)
+    assert t2 == 24
+    assert trees_equal(stw2, state.widen_state(ncfg, stn))
+    # Resuming the narrow hop continues the SAME universe.
+    a = run(wcfg, stw, 8, t0=24)[0]
+    b = run(ncfg, stn, 8, t0=24)[0]
+    assert trees_equal_values(a, b)
+    # A wide checkpoint holding a value past the narrow range refuses
+    # the hop instead of wrapping.
+    term = np.asarray(stw.nodes.term).copy()
+    term[0, 0] = 1 << 16
+    stbig = stw._replace(nodes=stw.nodes._replace(term=jnp.asarray(term)))
+    p3 = tmp_path / "big.npz"
+    checkpoint.save(str(p3), stbig, 24, cfg=wcfg)
+    with pytest.raises(ValueError, match="narrow-dtype overflow"):
+        checkpoint.load(str(p3), cfg=ncfg)
+
+
+# ------------------------------------------------- comparator + lint
+
+
+def test_values_only_comparator():
+    """values_only lifts INTEGER/bool dtype mismatches to a common
+    width and still catches value drift; strict mode stays byte-strict."""
+    a = {"x": jnp.arange(4, dtype=jnp.int32),
+         "b": jnp.array([True, False])}
+    b = {"x": jnp.arange(4, dtype=jnp.uint16),
+         "b": jnp.array([1, 0], dtype=jnp.int8)}
+    assert trees_equal_values(a, b)
+    assert not trees_equal(a, b)
+    c = {"x": jnp.array([0, 1, 2, 4], dtype=jnp.uint16),
+         "b": jnp.array([1, 0], dtype=jnp.int8)}
+    ok, why = trees_equal_why(a, c, values_only=True)
+    assert not ok and "x" in why
+
+
+def test_lint_flags_untagged_widening(tmp_path):
+    """The untagged-widening rule: an astype/jnp.<dtype> cast on a
+    traced State leaf chain in a hot-loop file needs `# widen-ok`;
+    derived expressions and tagged lines pass. The real hot loops are
+    clean (lint_default has no untagged-widening findings)."""
+    from raft_tpu.analysis import lint
+
+    fix = tmp_path / "step.py"
+    fix.write_text(
+        "import jax.numpy as jnp\n"
+        "I32 = jnp.int32\n\n\n"
+        "def tick(cfg, st, t):\n"
+        "    a = st.nodes.term.astype(I32)\n"
+        "    b = jnp.int32(st.nodes.commit)\n"
+        "    c = st.nodes.applied.astype(I32)   # widen-ok\n"
+        "    d = (st.nodes.term == 0).astype(I32)\n"
+        "    return a, b, c, d\n")
+    found = [f for f in lint.lint_file(str(fix))
+             if f.rule == "untagged-widening"]
+    assert sorted(f.line for f in found) == [6, 7]
+    assert all("widen-ok" in f.message for f in found)
+    assert not [f for f in lint.lint_default()
+                if f.rule == "untagged-widening"]
+
+
+# --------------------------------------------- verification at narrow
+
+
+def test_mcheck_narrow_agreement():
+    """Exhaustive small-scope walk: every predicate verdict identical
+    at wide and narrow view widths (the _signed lifts hold)."""
+    from raft_tpu.verify import mcheck
+
+    assert mcheck.narrow_agreement_problems(ticks=2, max_states=200) == []
+    assert mcheck.narrow_agreement_problems(ticks=2, max_states=120,
+                                            sessions=True) == []
+
+
+@pytest.mark.parametrize("name", [
+    "accept_stale_append", "minority_quorum",
+    "commit_past_match", "truncate_committed"])
+def test_mutant_killed_at_narrow_width(name):
+    """The kill matrix reproduces with predicates evaluated on
+    narrow-native views: the mutant dies with the SAME predicate
+    family, and the real oracle survives the same drive, exhaustively.
+    (A representative slice per predicate family — the full 14-mutant
+    matrix runs wide in test_verify; narrow evaluation only changes
+    the view dtypes, so one member per family pins each _signed lift.)
+    """
+    from raft_tpu.core.node import Node
+    from raft_tpu.verify import mcheck
+    from raft_tpu.verify.mutants import by_name
+
+    m = by_name(name)
+    rm = mcheck.check(m.bounds, m.node_cls, prefix=m.prefix, narrow=True)
+    assert not rm.ok, f"{name}: mutant survived at narrow width"
+    assert m.expect in rm.violation["predicates"]
+    rc = mcheck.check(m.bounds, Node, prefix=m.prefix, narrow=True)
+    assert rc.ok and rc.complete, f"{name}: clean oracle tripped narrow"
+
+
+def test_manifest_narrow_keys_and_segment_fields():
+    """NARROW_KEYS ride every record from birth (null), survive caller
+    values, backfill onto pre-r19 records, and the roofline producer
+    emits exactly the registry."""
+    from raft_tpu.analysis import bytemodel
+    from raft_tpu.obs import roofline
+    from raft_tpu.obs.history import backfill_record
+    from raft_tpu.obs.manifest import NARROW_KEYS, emit_manifest
+
+    cfg = RaftConfig(n_groups=2, k=3, seed=3, log_cap=8, compact_every=4)
+    rec = emit_manifest("narrow-probe", cfg, path="-")
+    assert all(rec[k] is None for k in NARROW_KEYS)
+    fields = roofline.narrow_segment_fields(dataclasses.replace(
+        cfg, **ALL_DIALS))
+    assert set(fields) == set(NARROW_KEYS)
+    assert all(fields[f] for f in NARROW_FIELDS)
+    assert fields["narrow_resident_bytes_per_group"] == \
+        bytemodel.narrow_resident_bytes_per_group(
+            dataclasses.replace(cfg, **ALL_DIALS))
+    rec2 = emit_manifest("narrow-probe", cfg, path="-", **fields)
+    assert all(rec2[k] == fields[k] for k in NARROW_KEYS)
+    old = {k: v for k, v in rec.items() if k not in NARROW_KEYS}
+    assert all(backfill_record(old)[k] is None for k in NARROW_KEYS)
