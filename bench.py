@@ -54,6 +54,7 @@ with a regression gate.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import functools
 import json
 import os
@@ -74,7 +75,8 @@ from raft_tpu.config import RaftConfig
 # and the soak heartbeat.
 from raft_tpu.obs import (dump_flight, emit_manifest, flight_init,
                           run_recorded)
-from raft_tpu.obs.manifest import NEMESIS_KEYS, PACKING_KEYS
+from raft_tpu.obs.manifest import (NEMESIS_KEYS, PACKING_KEYS,
+                                   PRESSURE_KEYS)
 from raft_tpu.obs import roofline as obs_roofline
 from raft_tpu.obs import trace as obs_trace
 from raft_tpu.sim.run import (latency_censored, latency_quantile,
@@ -1186,6 +1188,188 @@ def bench_clients(seed: int, n_groups: int, ticks: int, label: str):
     return seg
 
 
+# Knee protocol (DESIGN.md §19): each load point is graded against a
+# two-part SLO. (1) p99 ack latency, in ticks — six retry-backoff
+# windows, so an op that rode out a full disk-full sub-epoch plus a
+# handful of ambiguous-failure retries still acks inside it. (2) a
+# shed-rate budget: under a BOUNDED admission queue the ack histogram
+# deliberately excludes backlog queueing delay, so p99 stays flat
+# while overload shows up as definitive rejects — the shed budget is
+# what makes the knee interior to the swept range instead of pinned
+# at the top rung.
+PRESSURE_ACK_SLO_TICKS = 48
+PRESSURE_SHED_SLO = 0.05
+
+# Offered-load ladder (client arrivals per slot per tick) and the
+# admission cap the whole sweep runs with. The ladder spans below and
+# above the hash-gated service rate under pressure, so at least one
+# point meets the SLO and at least one saturates.
+PRESSURE_RATES = (0.05, 0.1, 0.2, 0.35, 0.5)
+PRESSURE_QUEUE_CAP = 8
+
+
+def _pressure_fields(cfg, knee: dict | None) -> dict:
+    """The r20 manifest stamp (obs.manifest.PRESSURE_KEYS): the knee
+    the sweep found — max sustained ops/s meeting the p99 ack SLO, the
+    shed rate the admission queue ran at there, and the hash of the
+    pressure program the whole sweep shared. Nulls = no load point met
+    the SLO (the degradation story is then the per-point table). Same
+    drift guard as _nemesis_fields: the producer is checked against
+    the registry it fills."""
+    from raft_tpu import nemesis
+    vals = {"knee_ops_per_sec": (round(knee["ops_per_sec"], 1)
+                                 if knee else None),
+            "shed_rate_at_knee": (knee["shed_rate"] if knee else None),
+            "pressure_program_hash": nemesis.program_hash(cfg.nemesis)}
+    if set(vals) != set(PRESSURE_KEYS):
+        raise RuntimeError(f"obs.manifest.PRESSURE_KEYS {PRESSURE_KEYS} "
+                           f"drifted from the bench producer {set(vals)}")
+    return vals
+
+
+def bench_pressure(seed: int, n_groups: int, ticks: int, label: str):
+    """Graceful-degradation knee segment (DESIGN.md §19): sweep offered
+    client load up a fixed ladder under the canonical storage-pressure
+    program (`nemesis.pressure_mix` — disk-full follower + compaction
+    pressure) with the bounded admission queue ON, and report the KNEE:
+    the max sustained committed-exactly-once ops/s whose p99 ack
+    latency still meets the SLO, plus the shed rate the admission gate
+    sustained there. Above the knee the story the table tells is
+    degradation, not collapse — definitive sheds rise and p99 grows,
+    but safety and exactly-once accounting (shed ledger included) stay
+    clean at EVERY point, which this segment asserts.
+
+    The sweep runs on the XLA engine (each load point is its own
+    compiled universe — client_rate is static); the kernel engine then
+    re-runs the KNEE point under the unchanged full State + Metrics +
+    flight-ring bit-identity gate, so the published knee rate is
+    promoted exactly like every other segment's number."""
+    from raft_tpu import nemesis
+    base = _seg_cfg(seed=seed, sessions=True, cmds_per_tick=0,
+                    client_rate=PRESSURE_RATES[0], client_slots=4,
+                    client_retry_backoff=8,
+                    client_queue_cap=PRESSURE_QUEUE_CAP,
+                    nemesis=nemesis.pressure_mix(ticks))
+    log(f"  [{label}] program {nemesis.program_hash(base.nemesis)}: "
+        f"{nemesis.describe(base.nemesis)}; SLO p99 <= "
+        f"{PRESSURE_ACK_SLO_TICKS} ticks AND shed <= "
+        f"{PRESSURE_SHED_SLO:.0%}, queue cap {PRESSURE_QUEUE_CAP}")
+    points, knee, knee_run, x_warmup_s = [], None, None, None
+    x_total_s = 0.0
+    for rate in PRESSURE_RATES:
+        cfg = dataclasses.replace(base, client_rate=rate)
+        t0 = time.perf_counter()
+        with obs_trace.span(f"warmup+compile xla [{label} rate={rate}]"):
+            wst, _, _ = run_recorded(cfg, sim.init(cfg, n_groups=n_groups),
+                                     CHUNK, 0,
+                                     metrics_init(n_groups, clients=True),
+                                     flight_init(n_groups))
+            jax.block_until_ready(wst)
+        warm = time.perf_counter() - t0
+        if x_warmup_s is None:
+            x_warmup_s = warm
+        st = sim.init(cfg, n_groups=n_groups)
+        m = metrics_init(n_groups, clients=True)
+        f = flight_init(n_groups)
+        start = time.perf_counter()
+        with obs_trace.span(f"timed xla [{label} rate={rate}]"):
+            for tick_at in range(0, ticks, CHUNK):
+                n = min(CHUNK, ticks - tick_at)
+                with obs_trace.chunk_span("xla", tick_at, n, phase="timed"):
+                    st, m, f = run_recorded(cfg, st, n, tick_at, m, f)
+            acked = total_client_ops(m)     # fetch closes the timer
+        elapsed = time.perf_counter() - start
+        x_total_s += elapsed
+        cl = st.clients
+        shed = int(np.asarray(cl.shed).astype(np.int64).sum())
+        # Every offered arrival is, at the endpoint, exactly one of:
+        # completed (done), still queued (backlog), in flight, or
+        # definitively shed at the admission gate.
+        admitted = sum(int(np.asarray(x).astype(np.int64).sum())
+                       for x in (cl.done, cl.backlog, cl.inflight))
+        p99 = latency_quantile(m.client_hist, 0.99)
+        censored = latency_censored(m.client_hist, 0.99)
+        unsafe = _safety_check(f"{label} rate={rate}", m, f, n_groups)
+        eo_ok, eo_why = exactly_once_report(cfg, st, m)
+        if not eo_ok:
+            log(f"  [{label} rate={rate}] EXACTLY-ONCE VIOLATED: {eo_why}")
+        pt = {"offered_rate": rate,
+              "ops_per_sec": round(acked / elapsed, 1),
+              "acked_ops": acked,
+              "ack_p99_ticks": p99, "ack_p99_censored": censored,
+              "shed": shed,
+              "shed_rate": round(shed / max(1, shed + admitted), 4),
+              "slo_ok": (p99 <= PRESSURE_ACK_SLO_TICKS and not censored
+                         and shed / max(1, shed + admitted)
+                         <= PRESSURE_SHED_SLO),
+              "unsafe_groups": unsafe,
+              "safety_ok": unsafe == 0 and eo_ok}
+        points.append(pt)
+        log(f"  [{label}] rate={rate}: {acked} acked "
+            f"({pt['ops_per_sec']:,.0f} ops/s), p99={p99}"
+            f"{' [CENSORED]' if censored else ''}, shed={shed} "
+            f"({pt['shed_rate']:.2%}), "
+            f"{'MEETS' if pt['slo_ok'] else 'misses'} SLO")
+        if pt["slo_ok"] and pt["safety_ok"] and (
+                knee is None or pt["ops_per_sec"] > knee["ops_per_sec"]):
+            knee, knee_run = pt, (cfg, st, m, f)
+    if knee is None:
+        log(f"  [{label}] NO load point met the SLO — knee unresolved "
+            f"(manifest keys stay null); the ladder needs a lower rung")
+        engine, k_elapsed, k_warmup_s = "xla-scan", None, None
+        state_ok = metrics_ok = flight_ok = None
+        nd, k_name = 1, "pallas"
+    else:
+        log(f"  [{label}] knee: rate={knee['offered_rate']} -> "
+            f"{knee['ops_per_sec']:,.0f} ops/s at p99="
+            f"{knee['ack_p99_ticks']} ticks, shed rate "
+            f"{knee['shed_rate']:.2%}")
+        kcfg, st, m, f = knee_run
+        pal = _pallas_full_run(kcfg, n_groups, ticks, "kacked", label,
+                               st, m, f)
+        engine, k_elapsed, k_warmup_s = (pal["engine"], pal["k_elapsed"],
+                                         pal["k_warmup_s"])
+        state_ok, metrics_ok, flight_ok = (pal["state_ok"],
+                                           pal["metrics_ok"],
+                                           pal["flight_ok"])
+        nd, k_name = pal["nd"], pal["k_name"]
+        if pal["promoted"]:
+            knee["ops_per_sec"] = round(knee["acked_ops"] / k_elapsed, 1)
+    cfg = knee_run[0] if knee_run else base
+    seg = {
+        **_pressure_fields(cfg, knee),
+        "ack_slo_p99_ticks": PRESSURE_ACK_SLO_TICKS,
+        "shed_slo": PRESSURE_SHED_SLO,
+        "queue_cap": PRESSURE_QUEUE_CAP,
+        "knee_offered_rate": knee["offered_rate"] if knee else None,
+        "knee_ack_p99_ticks": knee["ack_p99_ticks"] if knee else None,
+        "load_points": points,
+        "exactly_once_ok": all(p["safety_ok"] for p in points),
+        "engine": engine,
+        "state_identical": state_ok, "metrics_identical": metrics_ok,
+        "flight_identical": flight_ok,
+        "n_groups": n_groups, "ticks": ticks,
+        **_nemesis_fields(cfg),
+        **_wall_fields(k_elapsed if knee and pal["promoted"] else x_total_s,
+                       xla_wall_s=x_total_s,
+                       xla_warmup_wall_s=x_warmup_s,
+                       kernel_wall_s=k_elapsed,
+                       kernel_warmup_wall_s=k_warmup_s),
+        "safety_ok": all(p["safety_ok"] for p in points),
+        "unsafe_groups": max(p["unsafe_groups"] for p in points),
+        "workload": workload_params(cfg),
+        **_mesh_fields(n_groups, nd if engine == k_name else 1),
+        **_roofline_fields(cfg, n_groups, engine, ticks,
+                           k_elapsed if knee and pal["promoted"]
+                           else x_total_s,
+                           nd=nd if engine == k_name else 1),
+        **_packing_fields(cfg),
+        **_stream_fields(cfg, pal if knee else None),
+    }
+    emit_manifest(label, cfg, device=_device_str(), **seg)
+    return seg
+
+
 def main():
     global _TRACE_PATH
     ap = argparse.ArgumentParser()
@@ -1290,6 +1474,7 @@ def main():
         rd_groups, rd_ticks = 1_000, 200
         cl_groups, cl_ticks = 1_000, 200
         nm_groups, nm_ticks = 1_000, 200
+        pr_groups, pr_ticks = 1_000, 200
     else:
         # The headline runs at the true config-5 shape: 100K groups.
         # (History: a TPU kernel fault at 100K groups blocked this shape
@@ -1305,6 +1490,11 @@ def main():
         rd_groups, rd_ticks = 50_000, 600   # ReadIndex-at-scale segment
         cl_groups, cl_ticks = 50_000, 600   # client-SLO-at-scale segment
         nm_groups, nm_ticks = 50_000, 600   # gray-failure segment (§14)
+        # Pressure-knee sweep (§19): each of the PRESSURE_RATES rungs
+        # is a full from-tick-0 run, so the per-rung shape is smaller
+        # than the single-run segments to keep the sweep's total wall
+        # in the same band.
+        pr_groups, pr_ticks = 20_000, 600
 
     # The trace must survive a mid-run crash: a bench that dies in
     # segment 5 of 6 is exactly the run whose timeline is needed, so
@@ -1332,6 +1522,10 @@ def main():
             "engines):")
         nm = segment("nemesis gray mix", bench_nemesis, 48, nm_groups,
                      nm_ticks, "nemesis gray mix")
+        log("storage-pressure knee (offered-load sweep under disk-full "
+            "+ compaction pressure, bounded admission):")
+        pr = segment("pressure knee", bench_pressure, 49, pr_groups,
+                     pr_ticks, "pressure knee")
 
         # Roofline contract (DESIGN.md §12, ISSUE r12 acceptance): every
         # segment must carry the three stamp fields — a segment emitted
@@ -1339,7 +1533,8 @@ def main():
         for name, seg in (("throughput", tp), ("config-4", c4),
                           ("config-5-faults", c5f),
                           ("election-rounds", c2), ("reads", rd),
-                          ("client-slo", cl), ("nemesis", nm)):
+                          ("client-slo", cl), ("nemesis", nm),
+                          ("pressure", pr)):
             missing = [k for k in obs_roofline.ROOFLINE_FIELDS
                        if k not in seg]
             missing += [k for k in SEGMENT_WALL_KEYS if k not in seg]
@@ -1359,8 +1554,9 @@ def main():
     # fold AND endpoint accounting) folds into the global safety bit:
     # a double-apply must trip the same top-level flag automation
     # watches, not only a buried per-segment field.
-    safety_ok = all(s["safety_ok"] for s in (tp, c4, c5f, c2, rd, cl, nm)) \
-        and cl["exactly_once_ok"]
+    safety_ok = all(s["safety_ok"]
+                    for s in (tp, c4, c5f, c2, rd, cl, nm, pr)) \
+        and cl["exactly_once_ok"] and pr["exactly_once_ok"]
     if not safety_ok:
         log("SAFETY: at least one segment dropped the per-tick safety "
             "bit — see the flight-recorder dumps above")
@@ -1455,6 +1651,23 @@ def main():
         "nemesis_engine": nm["engine"],
         "nemesis_state_identical": nm["state_identical"],
         "nemesis_safety_ok": nm["safety_ok"],
+        # Graceful-degradation knee (DESIGN.md §19): the max sustained
+        # exactly-once ops/s meeting the p99 ack SLO under the canonical
+        # storage-pressure program, with the shed rate the bounded
+        # admission queue ran at there. Nulls = no swept load point met
+        # the SLO (see the segment's load_points table).
+        "knee_ops_per_sec": pr["knee_ops_per_sec"],
+        "shed_rate_at_knee": pr["shed_rate_at_knee"],
+        "pressure_program_hash": pr["pressure_program_hash"],
+        "pressure_ack_slo_p99_ticks": pr["ack_slo_p99_ticks"],
+        "pressure_shed_slo": pr["shed_slo"],
+        "pressure_knee_ack_p99_ticks": pr["knee_ack_p99_ticks"],
+        "pressure_queue_cap": pr["queue_cap"],
+        "pressure_load_points": pr["load_points"],
+        "pressure_exactly_once_ok": pr["exactly_once_ok"],
+        "pressure_engine": pr["engine"],
+        "pressure_state_identical": pr["state_identical"],
+        "pressure_safety_ok": pr["safety_ok"],
         "device": f"{dev.platform}:{dev.device_kind}",
     }))
 

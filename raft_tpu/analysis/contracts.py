@@ -40,6 +40,11 @@ GATED_LEAVES = {
     "clients": (("is_req_snap_sessions",),
                 ("session_seq", "snap_session_seq"),
                 ("clients",)),
+    # Bounded admission control (r20, DESIGN.md §19) gates exactly one
+    # leaf INSIDE the clients subtree: the shed reject ledger. State
+    # entries with a dot are literal leaf dot-paths; the gate stacks on
+    # "clients" (its baseline below is the clients-on universe).
+    "admission": ((), (), ("clients.shed",)),
     # The nemesis scenario compiler (DESIGN.md §14) gates NOTHING: a
     # compiled program is pure hash masks over existing schedules —
     # zero new State leaves, zero new wire lanes. The empty row is the
@@ -60,13 +65,15 @@ def _nemesis_probe_program() -> tuple:
     """A program exercising every clause kind — the gating/nemesis
     passes' probe (built inline; analysis must not import the nemesis
     package at module level)."""
-    from raft_tpu.nemesis.program import (clock_skew, crash_storm,
+    from raft_tpu.nemesis.program import (clock_skew, compaction_pressure,
+                                          crash_storm, disk_full_follower,
                                           flaky_link, partition_wave,
                                           program, slow_follower,
                                           wan_delay)
     return program(slow_follower(0, 64), flaky_link(0, 64),
                    wan_delay(0, 64), clock_skew(0, 64),
-                   crash_storm(0, 64), partition_wave(0, 64))
+                   crash_storm(0, 64), partition_wave(0, 64),
+                   disk_full_follower(0, 64), compaction_pressure(0, 64))
 
 
 def _base_cfg() -> RaftConfig:
@@ -82,6 +89,10 @@ def _gate_cfgs() -> dict:
         "clients": dataclasses.replace(base, sessions=True,
                                        cmds_per_tick=0, client_rate=0.3,
                                        client_slots=2),
+        "admission": dataclasses.replace(base, sessions=True,
+                                         cmds_per_tick=0, client_rate=0.3,
+                                         client_slots=2,
+                                         client_queue_cap=4),
         "nemesis": dataclasses.replace(base,
                                        nemesis=_nemesis_probe_program()),
         "streaming": dataclasses.replace(base, stream_groups=True,
@@ -109,7 +120,8 @@ def metric_parity_problems() -> list[str]:
     body, now one pass of the auditor (the script is a thin wrapper)."""
     import jax.numpy as jnp
 
-    from raft_tpu.clients.state import (CLIENT_LEAVES, ClientState,
+    from raft_tpu.clients.state import (ADMISSION_LEAVES, CLIENT_LEAVES,
+                                        ClientState, active_client_leaves,
                                         clients_init)
     from raft_tpu.obs.recorder import (FLIGHT_LEAVES, RING, Flight,
                                        flight_init)
@@ -130,9 +142,10 @@ def metric_parity_problems() -> list[str]:
     if Flight._fields != FLIGHT_LEAVES:
         problems.append(f"Flight fields {Flight._fields} != wire order "
                         f"FLIGHT_LEAVES {FLIGHT_LEAVES}")
-    if ClientState._fields != CLIENT_LEAVES:
+    if ClientState._fields != CLIENT_LEAVES + ADMISSION_LEAVES:
         problems.append(f"ClientState fields {ClientState._fields} != wire "
-                        f"order CLIENT_LEAVES {CLIENT_LEAVES}")
+                        f"order CLIENT_LEAVES {CLIENT_LEAVES} + admission "
+                        f"leaves {ADMISSION_LEAVES}")
 
     # The active wire subset must drop EXACTLY the client lanes when
     # clients are off, and be the full tuple when on.
@@ -172,14 +185,27 @@ def metric_parity_problems() -> list[str]:
             if leaf.shape != want_shape[name]:
                 problems.append(f"Metrics.{name} shape {leaf.shape} != "
                                 f"{want_shape[name]}")
-    cs = clients_init(cfg_on, g)
-    for name in ClientState._fields:
-        leaf = getattr(cs, name)
-        if leaf.dtype != jnp.int32:
-            problems.append(f"ClientState.{name} dtype {leaf.dtype} != i32")
-        if leaf.shape != (g, cfg_on.client_slots):
-            problems.append(f"ClientState.{name} shape {leaf.shape} != "
-                            f"{(g, cfg_on.client_slots)}")
+    cfg_adm = dataclasses.replace(cfg_on, client_queue_cap=4)
+    for label, c in (("cap-off", cfg_on), ("cap-on", cfg_adm)):
+        cs = clients_init(c, g)
+        active = active_client_leaves(c)
+        for name in ClientState._fields:
+            leaf = getattr(cs, name)
+            if name not in active:
+                if leaf is not None:
+                    problems.append(f"[{label}] ClientState.{name} present "
+                                    f"with its admission gate off")
+                continue
+            if leaf is None:
+                problems.append(f"[{label}] ClientState.{name} is None but "
+                                f"active_client_leaves lists it")
+                continue
+            if leaf.dtype != jnp.int32:
+                problems.append(f"[{label}] ClientState.{name} dtype "
+                                f"{leaf.dtype} != i32")
+            if leaf.shape != (g, c.client_slots):
+                problems.append(f"[{label}] ClientState.{name} shape "
+                                f"{leaf.shape} != {(g, c.client_slots)}")
     f = flight_init(g)
     for name in Flight._fields:
         leaf = getattr(f, name)
@@ -206,7 +232,8 @@ def wire_registry_problems(pernode_fields: tuple | None = None,
     import numpy as np
 
     from raft_tpu import sim
-    from raft_tpu.clients.state import CLIENT_LEAVES, ClientState
+    from raft_tpu.clients.state import (ADMISSION_LEAVES, CLIENT_LEAVES,
+                                        ClientState)
     from raft_tpu.obs.recorder import PRESENCE_FIELDS
     from raft_tpu.sim import pkernel
     from raft_tpu.sim.state import Mailbox, PerNode
@@ -220,7 +247,8 @@ def wire_registry_problems(pernode_fields: tuple | None = None,
 
     problems = []
     sess_fields = ("session_seq", "snap_session_seq")
-    cfgs = {"clients-off": _base_cfg(), "clients-on": _gate_cfgs()["clients"]}
+    cfgs = {"clients-off": _base_cfg(), "clients-on": _gate_cfgs()["clients"],
+            "clients-admission": _gate_cfgs()["admission"]}
     all_on = dataclasses.replace(
         _gate_cfgs()["clients"], prevote=True, transfer_prob=0.5,
         read_every=4)
@@ -244,6 +272,7 @@ def wire_registry_problems(pernode_fields: tuple | None = None,
             on = {"prevote": cfg.prevote,
                   "transfer": cfg.transfer_u32 != 0,
                   "clients": clients,
+                  "admission": cfg.client_queue_cap > 0,
                   "nemesis": bool(cfg.nemesis),
                   "streaming": cfg.stream_groups}[gate]
             if not on:
@@ -255,15 +284,17 @@ def wire_registry_problems(pernode_fields: tuple | None = None,
             problems.append(
                 f"[{label}] pkernel._mb_fields misses {missing} / carries "
                 f"stale {extra} vs Mailbox._fields under this cfg")
-        # Leaf count promised to the kernel launch vs the registries.
-        n = (len(reg) + len(reg_mb) + 2
-             + (len(client_fields) if clients else 0))
+        # Leaf count promised to the kernel launch vs the registries
+        # (the admission-gated shed leaf rides the wire only cap-on).
+        n_cl = len(client_fields) if clients else 0
+        if clients and cfg.client_queue_cap == 0:
+            n_cl -= len(ADMISSION_LEAVES)
+        n = len(reg) + len(reg_mb) + 2 + n_cl
         if pkernel._n_state_leaves(cfg) != n:
             problems.append(
                 f"[{label}] pkernel._n_state_leaves {pkernel._n_state_leaves(cfg)} "
                 f"!= node {len(reg)} + mailbox {len(reg_mb)} + client "
-                f"{len(client_fields) if clients else 0} + alive_prev + "
-                f"group_id = {n}")
+                f"{n_cl} + alive_prev + group_id = {n}")
 
         # Kind table vs the real per-leaf shapes (eval_shape).
         st = jax.eval_shape(lambda c=cfg: sim.init(c, n_groups=2))
@@ -301,9 +332,10 @@ def wire_registry_problems(pernode_fields: tuple | None = None,
             f"obs.recorder.PRESENCE_FIELDS {sorted(PRESENCE_FIELDS)} != the "
             f"mailbox occupancy leaves {sorted(presence)} — the flight "
             f"recorder's message-volume signal would miss a message type")
-    if client_fields != CLIENT_LEAVES:
-        problems.append(f"CLIENT_LEAVES {CLIENT_LEAVES} != ClientState "
-                        f"fields {client_fields}")
+    if client_fields != CLIENT_LEAVES + ADMISSION_LEAVES:
+        problems.append(f"CLIENT_LEAVES {CLIENT_LEAVES} + admission leaves "
+                        f"{ADMISSION_LEAVES} != ClientState fields "
+                        f"{client_fields}")
     return problems
 
 
@@ -321,23 +353,31 @@ def gating_problems() -> list[str]:
     problems = []
     base = _base_cfg()
     base_names = _leaf_names(base)
+    # Gates that stack on another gate compare against THAT gate's
+    # universe, not the all-off base (admission requires clients on).
+    gate_base = {"admission": "clients"}
     for gate, cfg_on in _gate_cfgs().items():
         mb, nd, st_fields = GATED_LEAVES[gate]
         expect_new = {f"mailbox.{f}" for f in mb}
         expect_new |= {f"nodes.{f}" for f in nd}
-        if "clients" in st_fields:
-            from raft_tpu.clients.state import CLIENT_LEAVES
-            expect_new |= {f"clients.{f}" for f in CLIENT_LEAVES}
+        for f in st_fields:
+            if f == "clients":
+                from raft_tpu.clients.state import CLIENT_LEAVES
+                expect_new |= {f"clients.{x}" for x in CLIENT_LEAVES}
+            else:
+                expect_new.add(f)   # literal leaf dot-path (clients.shed)
+        ref_names = base_names if gate not in gate_base \
+            else _leaf_names(_gate_cfgs()[gate_base[gate]])
         on_names = _leaf_names(cfg_on)
-        got_new = on_names - base_names
+        got_new = on_names - ref_names
         if got_new != expect_new:
             problems.append(
                 f"gate {gate!r}: turning it on adds leaves "
                 f"{sorted(got_new)} but the gating table promises "
                 f"{sorted(expect_new)}")
-        if base_names - on_names:
+        if ref_names - on_names:
             problems.append(f"gate {gate!r}: turning it on REMOVES leaves "
-                            f"{sorted(base_names - on_names)}")
+                            f"{sorted(ref_names - on_names)}")
         # Kernel registries mirror the same gate.
         for f in mb:
             if f in pkernel._mb_fields(base):
@@ -465,11 +505,16 @@ def checkpoint_problems(ckpt_mod=None,
             f"checkpoint._optional_fields(PerNode) "
             f"{sorted(real_ckpt._optional_fields(PerNode))} != the "
             f"statically-gated node leaves {sorted(gated_nd)}")
-    if real_ckpt._optional_fields(ClientState):
+    gated_cl = {f.split(".", 1)[1] for _, _, stf in GATED_LEAVES.values()
+                for f in stf if f.startswith("clients.")}
+    if real_ckpt._optional_fields(ClientState) != frozenset(gated_cl):
         problems.append(
-            "ClientState declares optional leaves — the clients subtree is "
-            "all-or-nothing; an optional leaf would load as None and crash "
-            "the workload transition")
+            f"checkpoint._optional_fields(ClientState) "
+            f"{sorted(real_ckpt._optional_fields(ClientState))} != the "
+            f"statically-gated client leaves {sorted(gated_cl)} — the "
+            f"clients subtree is otherwise all-or-nothing (a spurious "
+            f"optional leaf would load as None and crash the workload "
+            f"transition)")
     if not include_behavioral:
         return problems
 
@@ -598,6 +643,41 @@ def checkpoint_problems(ckpt_mod=None,
                   _base_cfg(), nemesis=_nemesis_probe_program()),
               expect_raise=(ValueError,))
 
+    # r20 admission: an admission-on universe round-trips its shed
+    # ledger exactly...
+    cfg_label = "admission"
+    adm = _gate_cfgs()["admission"]
+    r = roundtrip(adm)
+    if r is not None and not isinstance(r, Exception):
+        st, _, (st2, _, _) = r
+        if st2.clients.shed is None or not np.array_equal(
+                np.asarray(st.clients.shed), np.asarray(st2.clients.shed)):
+            problems.append("admission round trip lost or changed the "
+                            "clients.shed ledger")
+    # ...a pre-r20 file (no shed leaf, no client_queue_cap knob —
+    # synthesized by stripping both from a cap-on save, so the strip
+    # guard proves the key names are live) loads under a cap-off cfg
+    # with the knob backfilled to its default...
+    cfg_label = "admission"
+    r = roundtrip(adm, strip=("state.clients.shed",),
+                  patch_cfg=("client_queue_cap",),
+                  load_cfg=_gate_cfgs()["clients"])
+    if r is None or isinstance(r, Exception):
+        problems.append("pre-r20 backfill drift: a client checkpoint "
+                        "predating admission control must load under a "
+                        "cap-off cfg (registry: checkpoint.load cfg "
+                        "setdefault + ClientState optional shed)")
+    else:
+        _, _, (st2, _, _) = r
+        if st2.clients.shed is not None:
+            problems.append("pre-r20 file loaded a phantom clients.shed "
+                            "leaf under a cap-off cfg")
+    # ...and REFUSES under a cap-on cfg: admission changes what the
+    # transition computes, so the semantics differ.
+    roundtrip(adm, strip=("state.clients.shed",),
+              patch_cfg=("client_queue_cap",),
+              load_cfg=adm, expect_raise=(ValueError,))
+
     # Strictness: a missing REQUIRED leaf must raise, naming the field.
     r = roundtrip(_base_cfg(), strip=("state.nodes.term",),
                   expect_raise=(KeyError,))
@@ -651,7 +731,7 @@ def packing_problems(include_behavioral: bool = True) -> list[str]:
     import numpy as np
 
     from raft_tpu import sim
-    from raft_tpu.clients.state import CLIENT_LEAVES
+    from raft_tpu.clients.state import active_client_leaves
     from raft_tpu.obs.recorder import flight_init
     from raft_tpu.sim import pkernel
     from raft_tpu.sim.pkernel import LANE, ROW_METRIC_LEAVES
@@ -675,7 +755,8 @@ def packing_problems(include_behavioral: bool = True) -> list[str]:
                           if f in pkernel._MB_BOOL])
         expect = (len(pkernel._node_leaves(cfg))
                   + len(pkernel._mb_fields(cfg)) + 2
-                  + (len(CLIENT_LEAVES) if cfg.clients_u32 else 0))
+                  + (len(active_client_leaves(cfg))
+                     if cfg.clients_u32 else 0))
         if cfg.pack_bools:
             expect -= n_mb_bools - 1     # bools collapse to ONE lane leaf
         if cfg.pack_ring:
@@ -960,7 +1041,9 @@ def narrowing_problems(include_behavioral: bool = True) -> list[str]:
 def nemesis_problems(kinds: tuple | None = None,
                      link_kinds: tuple | None = None,
                      crash_kinds: tuple | None = None,
-                     timing_kinds: tuple | None = None) -> list[str]:
+                     timing_kinds: tuple | None = None,
+                     disk_kinds: tuple | None = None,
+                     compact_kinds: tuple | None = None) -> list[str]:
     """The nemesis scenario compiler's contracts (DESIGN.md §14):
 
     - compiled programs add ZERO leaves — GATED_LEAVES carries the
@@ -968,9 +1051,10 @@ def nemesis_problems(kinds: tuple | None = None,
       State pytree nor any kernel wire registry nor the byte model
       (kleaf_spec has nothing new to cover, proven by the counts);
     - the seam partition is TOTAL: every clause kind is routed to
-      exactly one engine seam (link / crash / timing filter) — a kind
-      in none would be a silently-ignored clause, a kind in two would
-      double-apply;
+      exactly one engine seam (link / crash / timing filter, or the
+      r20 storage seams — the per-append disk budget and the phase-A
+      compaction gate) — a kind in none would be a silently-ignored
+      clause, a kind in two would double-apply;
     - the program builders cover every kind and `RaftConfig` normalizes
       a JSON-round-tripped program back to the identical hashable form;
     - utils.rng / utils.jrng evaluator parity rides the existing
@@ -992,16 +1076,21 @@ def nemesis_problems(kinds: tuple | None = None,
         else tuple(crash_kinds)
     timing_kinds = _r.NEM_TIMING_KINDS if timing_kinds is None \
         else tuple(timing_kinds)
+    disk_kinds = _r.NEM_DISK_KINDS if disk_kinds is None \
+        else tuple(disk_kinds)
+    compact_kinds = _r.NEM_COMPACT_KINDS if compact_kinds is None \
+        else tuple(compact_kinds)
 
     problems = []
     # Seam partition: every kind on exactly one seam.
-    routed = list(link_kinds) + list(crash_kinds) + list(timing_kinds)
+    routed = (list(link_kinds) + list(crash_kinds) + list(timing_kinds)
+              + list(disk_kinds) + list(compact_kinds))
     unrouted = [k for k in kinds if k not in routed]
     if unrouted:
         problems.append(
             f"nemesis kinds {unrouted} routed to NO engine seam "
-            f"(NEM_LINK/CRASH/TIMING_KINDS) — their clauses would be "
-            f"silently ignored by every engine")
+            f"(NEM_LINK/CRASH/TIMING/DISK/COMPACT_KINDS) — their clauses "
+            f"would be silently ignored by every engine")
     if len(routed) != len(set(routed)):
         dup = sorted({k for k in routed if routed.count(k) > 1})
         problems.append(f"nemesis kinds {dup} routed to MORE than one "
@@ -1287,7 +1376,15 @@ def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
     problems = []
     keys = (real_manifest.ROOFLINE_KEYS + real_manifest.PACKING_KEYS
             + real_manifest.NEMESIS_KEYS + real_manifest.STREAM_KEYS
-            + real_manifest.STREAM_MESH_KEYS + real_manifest.NARROW_KEYS)
+            + real_manifest.STREAM_MESH_KEYS + real_manifest.NARROW_KEYS
+            + real_manifest.PRESSURE_KEYS)
+    if tuple(real_history.R20_MANIFEST_KEYS) \
+            != tuple(real_manifest.PRESSURE_KEYS):
+        problems.append(
+            f"obs.history.R20_MANIFEST_KEYS {real_history.R20_MANIFEST_KEYS}"
+            f" != obs.manifest.PRESSURE_KEYS "
+            f"{real_manifest.PRESSURE_KEYS} — the emit-side and "
+            f"backfill-side key lists drifted")
     if tuple(real_history.R19_MANIFEST_KEYS) \
             != tuple(real_manifest.NARROW_KEYS):
         problems.append(
@@ -1369,7 +1466,10 @@ def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
                              stream_devices=8, stream_blocks_per_device=1,
                              stream_slowest_device=3,
                              narrow_scalars=True,
-                             narrow_resident_bytes_per_group=2494)
+                             narrow_resident_bytes_per_group=2494,
+                             knee_ops_per_sec=1.5e6,
+                             shed_rate_at_knee=0.02,
+                             pressure_program_hash="deadbeef")
     for k, want in (("bound", "hbm"), ("attainment_pct", 12.5),
                     ("predicted_rounds_per_sec", 1.0),
                     ("pack_bools", True), ("wire_hist", False),
@@ -1378,7 +1478,10 @@ def manifest_problems(manifest_mod=None, history_mod=None) -> list[str]:
                     ("stream_devices", 8), ("stream_blocks_per_device", 1),
                     ("stream_slowest_device", 3),
                     ("narrow_scalars", True),
-                    ("narrow_resident_bytes_per_group", 2494)):
+                    ("narrow_resident_bytes_per_group", 2494),
+                    ("knee_ops_per_sec", 1.5e6),
+                    ("shed_rate_at_knee", 0.02),
+                    ("pressure_program_hash", "deadbeef")):
         if rec2.get(k) != want:
             problems.append(f"manifest dropped the caller's {k!r} value "
                             f"({rec2.get(k)!r} != {want!r})")

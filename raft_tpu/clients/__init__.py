@@ -11,14 +11,17 @@ PerNode.session_seq`); the per-tick exactly-once invariant in
 `sim/run.py Metrics` / `sim/pkernel.py KMetrics`.
 """
 
-from raft_tpu.clients.state import CLIENT_LEAVES, ClientState, clients_init
+from raft_tpu.clients.state import (ADMISSION_LEAVES, CLIENT_LEAVES,
+                                    ClientState, active_client_leaves,
+                                    clients_init)
 from raft_tpu.clients.workload import (HostClients, client_update,
                                        clients_64_cfg, exactly_once_report,
                                        submit_payloads, table_max,
                                        workload_params)
 
 __all__ = [
-    "CLIENT_LEAVES", "ClientState", "HostClients", "client_update",
-    "clients_64_cfg", "clients_init", "exactly_once_report",
-    "submit_payloads", "table_max", "workload_params",
+    "ADMISSION_LEAVES", "CLIENT_LEAVES", "ClientState", "HostClients",
+    "active_client_leaves", "client_update", "clients_64_cfg",
+    "clients_init", "exactly_once_report", "submit_payloads", "table_max",
+    "workload_params",
 ]

@@ -22,10 +22,28 @@ import jax.numpy as jnp
 
 I32 = jnp.int32
 
-# Wire/leaf order of the client state — `ClientState._fields` IS the
-# contract (scripts/check_metric_parity.py pins dtype/shape).
+# Wire/leaf order of the BASE client state — the unconditional
+# clients-on wire (scripts/check_metric_parity.py pins dtype/shape).
+# `ClientState._fields` is this tuple plus the statically-gated
+# admission leaves below; `active_client_leaves(cfg)` is the per-cfg
+# wire order every engine iterates.
 CLIENT_LEAVES = ("done", "backlog", "inflight", "t_start", "t_sub",
                  "submit", "retries", "last_lat")
+
+# Leaves that exist IFF bounded admission control is on
+# (cfg.client_queue_cap > 0; r20, DESIGN.md §19) — optional NamedTuple
+# fields (default None) so a cap-off universe's wire, checkpoint key
+# set, and pytree are byte-identical to r19.
+ADMISSION_LEAVES = ("shed",)
+
+
+def active_client_leaves(cfg) -> tuple:
+    """The cfg's client wire order: the base leaves, plus the admission
+    leaves when the bounded queue is on. THE iteration rule for every
+    client-leaf consumer (kernel wire pack/unpack, narrow specs, byte
+    models) — a gated leaf must never ride the wire gate-off."""
+    return CLIENT_LEAVES + (ADMISSION_LEAVES
+                            if cfg.client_queue_cap > 0 else ())
 
 # Narrow RESIDENT dtypes under cfg.narrow_clients (r19, DESIGN.md §18
 # range table) — the authority `sim.state.narrow_spec` prices
@@ -40,6 +58,9 @@ NARROW_CLIENT_SPEC = {
     "t_sub": jnp.uint16, "retries": jnp.uint16,
     "inflight": jnp.int8, "submit": jnp.int8,
     "last_lat": jnp.int16,
+    # shed counts rejected arrivals — at most one per tick, so it fits
+    # u16 under the same <= 65,535-tick audited horizon as done.
+    "shed": jnp.uint16,
 }
 
 
@@ -54,6 +75,8 @@ class ClientState(NamedTuple):
     submit: jnp.ndarray    # 0/1 pulse: leaders append this op next tick
     retries: jnp.ndarray   # re-submissions to date (potential duplicates)
     last_lat: jnp.ndarray  # ack latency of an op acked THIS tick; -1 none
+    # Admission control (cfg.client_queue_cap > 0; None otherwise):
+    shed: jnp.ndarray = None   # arrivals definitively rejected at the cap
 
 
 def clients_init(cfg, n_groups: int) -> ClientState:
@@ -62,4 +85,5 @@ def clients_init(cfg, n_groups: int) -> ClientState:
     return ClientState(done=z, backlog=z, inflight=z, t_start=z, t_sub=z,
                        submit=z, retries=z,
                        last_lat=jnp.full((n_groups, cfg.client_slots),
-                                         -1, I32))
+                                         -1, I32),
+                       shed=z if cfg.client_queue_cap > 0 else None)

@@ -47,10 +47,13 @@ import numpy as np
 
 from raft_tpu import config as _c
 from raft_tpu.config import RaftConfig
-from raft_tpu.clients.state import CLIENT_LEAVES, ClientState, clients_init
+from raft_tpu.clients.state import (ADMISSION_LEAVES, CLIENT_LEAVES,
+                                    ClientState, active_client_leaves,
+                                    clients_init)
 from raft_tpu.utils import jrng, rng
 
-__all__ = ["CLIENT_LEAVES", "ClientState", "clients_init", "client_update",
+__all__ = ["ADMISSION_LEAVES", "CLIENT_LEAVES", "ClientState",
+           "active_client_leaves", "clients_init", "client_update",
            "submit_payloads", "HostClients", "table_max",
            "exactly_once_report", "clients_64_cfg", "workload_params"]
 
@@ -85,6 +88,7 @@ def workload_params(cfg: RaftConfig) -> dict:
     return {"rate": cfg.client_rate, "slots": cfg.client_slots,
             "retry_backoff": cfg.client_retry_backoff,
             "retry_policy": "fixed-interval-resubmit",
+            "queue_cap": cfg.client_queue_cap,
             "seed": cfg.seed}
 
 
@@ -108,6 +112,15 @@ def client_update(cfg: RaftConfig, cs: ClientState, tmax, g, sid, t
     # Open-loop arrival, gated on the 10-bit lifetime bound.
     room = (done + cs.backlog + inflight) <= _c.SESSION_SEQ_MASK
     arrive = jrng.client_arrives(cfg.seed, g, sid, t, cfg.clients_u32) & room
+    shed = cs.shed
+    if cfg.client_queue_cap > 0:
+        # Bounded admission (r20, DESIGN.md §19): an arrival that would
+        # push the backlog past the cap is SHED — a definitive reject,
+        # never issued a seq, never retried. The static gate keeps the
+        # cap-off transition byte-identical to r19.
+        admit = cs.backlog < cfg.client_queue_cap
+        shed = shed + (arrive & ~admit).astype(I32)
+        arrive = arrive & admit
     backlog = cs.backlog + arrive.astype(I32)
     # Retry BEFORE start: only an op that stayed in flight re-submits.
     retry = (inflight != 0) & ((t - cs.t_sub) >= cfg.client_retry_backoff)
@@ -122,6 +135,7 @@ def client_update(cfg: RaftConfig, cs: ClientState, tmax, g, sid, t
         submit=submit,
         retries=cs.retries + retry.astype(I32),
         last_lat=last_lat,
+        shed=shed,
     )
 
 
@@ -159,6 +173,7 @@ class HostClients:
         self.submit = [0] * s
         self.retries = [0] * s
         self.last_lat = [-1] * s
+        self.shed = [0] * s      # admission rejects (cap > 0 only)
         # Host-side SLO tally (the oracle's analogue of the client
         # metric lanes): completed-op ack latencies, in ticks.
         self.latencies: list[int] = []
@@ -190,7 +205,11 @@ class HostClients:
                     <= _c.SESSION_SEQ_MASK)
             if room and rng.client_arrives(cfg.seed, self.g, s, t,
                                            cfg.clients_u32):
-                self.backlog[s] += 1
+                if (cfg.client_queue_cap > 0
+                        and self.backlog[s] >= cfg.client_queue_cap):
+                    self.shed[s] += 1   # definitive reject (no seq, no retry)
+                else:
+                    self.backlog[s] += 1
             retry = (self.inflight[s]
                      and t - self.t_sub[s] >= cfg.client_retry_backoff)
             start = not self.inflight[s] and self.backlog[s] > 0
@@ -222,7 +241,14 @@ def exactly_once_report(cfg: RaftConfig, st, metrics=None):
       crash-stable form of "every ack is table-backed" — a
       mid-recovery node legitimately lags, a caught-up one cannot);
     - metric accounting (when `metrics` carries client lanes):
-      `client_acked[g] == sum_s done[g, s]` exactly.
+      `client_acked[g] == sum_s done[g, s]` exactly;
+    - admission accounting (cfg.client_queue_cap > 0; r20): the shed
+      ledger exists exactly when the cap is on, no backlog ever
+      exceeds the cap (the admission gate is the ONLY producer), and
+      shed counts are nonnegative — a shed arrival was a definitive
+      reject that provably never entered seq space, so it can appear
+      in no dedup table (already covered by the frontier check: shed
+      never advances `done`).
     """
     nodes = st.nodes
     cl = st.clients
@@ -258,7 +284,28 @@ def exactly_once_report(cfg: RaftConfig, st, metrics=None):
         acked = np.asarray(metrics.client_acked)
         if not np.array_equal(acked, done.sum(axis=1)):
             problems.append("client_acked metric != sum of per-slot done")
+    cap = cfg.client_queue_cap
+    if (cl.shed is None) != (cap == 0):
+        problems.append(
+            f"ClientState.shed {'absent' if cl.shed is None else 'present'} "
+            f"but cfg.client_queue_cap == {cap} — the shed ledger must "
+            f"exist exactly when admission control is on")
+    n_shed = 0
+    if cap > 0 and cl.shed is not None:
+        shed = np.asarray(cl.shed)
+        n_shed = int(shed.sum())
+        if (shed < 0).any():
+            problems.append("negative shed count — the reject ledger "
+                            "only ever increments")
+        over_cap = np.asarray(cl.backlog) > cap
+        if over_cap.any():
+            problems.append(
+                f"{int(over_cap.any(axis=1).sum())} group(s) hold a "
+                f"backlog above client_queue_cap={cap} — an arrival "
+                f"bypassed the admission gate")
     return (not problems,
             "; ".join(problems) if problems else
             f"exactly-once ok over {g} group(s) x {s} slot(s): "
-            f"{int(done.sum())} acked op(s), tables consistent")
+            f"{int(done.sum())} acked op(s)"
+            + (f", {n_shed} shed" if cap > 0 else "")
+            + ", tables consistent")

@@ -162,6 +162,18 @@ class RaftConfig:
     client_slots: int = 4
     client_retry_backoff: int = 8
 
+    # Bounded-queue admission control (r20, DESIGN.md §19): when > 0,
+    # a scheduled arrival that would push a session's backlog to
+    # client_queue_cap or beyond is SHED — a definitive reject, counted
+    # in ClientState.shed, never issued a seq, never retried (no
+    # ambiguity: the op provably never entered the replicated log, the
+    # exactly-once ledger in clients.workload.exactly_once_report
+    # accounts arrivals = issued + shed). SEMANTIC knob (config_hash,
+    # checkpoint match); 0 = off, the shed leaf and every admission
+    # compare are statically absent and the wire is byte-identical to
+    # r19. Requires the scheduled client subsystem (client_rate > 0).
+    client_queue_cap: int = 0
+
     # Fault injection (DESIGN.md §4). All off by default.
     drop_prob: float = 0.0       # per-link per-tick message loss
     crash_prob: float = 0.0      # per-node per-epoch crash probability
@@ -336,7 +348,8 @@ class RaftConfig:
                 raise ValueError(f"nemesis clause a={a} outside u32")
             if not 0 <= b <= _U32:
                 raise ValueError(f"nemesis clause b={b} outside u32")
-            if kind in (_nem.NEM_FLAKY, _nem.NEM_STORM, _nem.NEM_WAVE) \
+            if kind in (_nem.NEM_FLAKY, _nem.NEM_STORM, _nem.NEM_WAVE,
+                        _nem.NEM_DISK, _nem.NEM_COMPACT) \
                     and a < 1:
                 raise ValueError(f"nemesis clause kind {kind} needs its "
                                  f"epoch/period a >= 1, got {a}")
@@ -382,6 +395,13 @@ class RaftConfig:
             assert 1 <= self.client_slots <= 16, (
                 "client_slots must be in [1, 16]")
             assert self.client_retry_backoff >= 1
+        assert self.client_queue_cap >= 0, (
+            "client_queue_cap must be >= 0 (0 = admission control off)")
+        if self.client_queue_cap > 0:
+            assert self.client_rate > 0.0, (
+                "client_queue_cap > 0 needs client_rate > 0: admission "
+                "control bounds the scheduled clients' backlog queues, "
+                "which only exist under the scheduled-traffic subsystem")
         assert self.cohort_blocks >= 1, (
             "cohort_blocks must be >= 1: the cohort scheduler pages "
             "whole 1024-group blocks and an empty window pages nothing")
@@ -472,3 +492,21 @@ class RaftConfig:
     def nem_skew(self) -> tuple:
         return tuple(c for c in self.nemesis
                      if c[0] in _nem.NEM_TIMING_KINDS)
+
+    @property
+    def nem_disk(self) -> tuple:
+        """Disk-full-follower clauses → the append/persistence seam
+        (r20, DESIGN.md §19): every local append on the hash-chosen
+        target node fails while the clause holds, so entries are never
+        durable and must never be acked."""
+        return tuple(c for c in self.nemesis
+                     if c[0] in _nem.NEM_DISK_KINDS)
+
+    @property
+    def nem_compact(self) -> tuple:
+        """Compaction-pressure clauses → the snapshot/compaction seam
+        (r20, DESIGN.md §19): a blocked node's phase-A compaction is
+        delayed, the log_cap ring genuinely fills, and the window
+        invariant becomes a runtime backpressure path."""
+        return tuple(c for c in self.nemesis
+                     if c[0] in _nem.NEM_COMPACT_KINDS)

@@ -95,6 +95,15 @@ class Node:
         # completions and is part of the differential trace surface.
         self.sched_read = None
         self.reads_done = 0
+        # Storage-pressure override (r20, DESIGN.md §19): the bounded
+        # model checker forces THIS node's disk full for the current
+        # tick by setting these before the phases — an adversarial
+        # over-approximation of the hashed nemesis schedule, same
+        # soundness argument as mcheck's adversarial crashes. The
+        # harness never sets them; production pressure comes from
+        # cfg.nem_disk / cfg.nem_compact.
+        self.disk_override = False
+        self.compact_override = False
         self._reset_election_timer()
 
     # ------------------------------------------------------------- log helpers
@@ -149,8 +158,35 @@ class Node:
     def _window_has_room(self, n: int = 1) -> bool:
         return self.last_index + n - self.snap_index <= self.cfg.log_cap
 
+    def _disk_full(self) -> bool:
+        """Persistence budget exhausted at the current tick (r20,
+        DESIGN.md §19): every local append fails — an entry that is
+        not durable must never be acked, so the AE entry walk stops
+        here and the follower's partial-prefix reply (match=hi) is the
+        NACK that makes the leader retransmit. In-place term rewrites
+        and snapshot installs are NOT appends and stay live."""
+        if self.disk_override:
+            return True
+        nem_disk = self.cfg.nem_disk
+        return bool(nem_disk and rng.nem_disk_full(
+            self.cfg.seed, nem_disk, self.g, self.id, self.now,
+            self.cfg.k))
+
+    def _compact_blocked(self) -> bool:
+        """Compaction pressure at the current tick (r20, DESIGN.md
+        §19): phase A's snapshot step is delayed, the log_cap ring
+        genuinely fills, and `_append`'s window check becomes the
+        runtime backpressure path that throttles replication."""
+        if self.compact_override:
+            return True
+        nem_compact = self.cfg.nem_compact
+        return bool(nem_compact and rng.nem_compact_block(
+            self.cfg.seed, nem_compact, self.g, self.id, self.now))
+
     def _append(self, term: int, payload: int) -> bool:
         if not self._window_has_room(1):
+            return False
+        if self._disk_full():
             return False
         self.log.append((term, payload))
         return True
@@ -576,6 +612,19 @@ class Node:
             return None
         return self.last_index
 
+    def admit_and_propose(self, sid: int, seq: int, val: int, shed: bool):
+        """Admission seam of the bounded client queue (r20, DESIGN.md
+        §19). A shed arrival gets a DEFINITIVE reject: the op never
+        enters the log, its seq is never consumed, and the client must
+        not retry it — so an admission layer that says "rejected" yet
+        still proposes is a safety bug, not a liveness one. The mutant
+        harness overrides exactly this method (shed_then_apply); the
+        applied-seq frontier then outruns the issued frontier and
+        invariants.client_safety kills it."""
+        if shed:
+            return None
+        return self.propose_seq(sid, seq, val)
+
     def read_begin(self):
         """Begin a linearizable ReadIndex read (Raft dissertation §6.4).
 
@@ -845,7 +894,8 @@ class Node:
                 self.digest = rng.digest_update(self.digest, self.applied, p)
             if self.on_apply is not None:
                 self.on_apply(self.id, self.applied, t, p)
-        if self.commit - self.snap_index >= self.cfg.compact_every:
+        if (self.commit - self.snap_index >= self.cfg.compact_every
+                and not self._compact_blocked()):
             self.snap_voters = self.committed_config()
             self.snap_sessions = dict(self.sessions)
             self.snap_term = self.term_at(self.commit)

@@ -10,13 +10,15 @@ auto-shrinking minimal-reproducer machinery.
   ``sim.step -> nemesis.program`` can never become a cycle).
 """
 
-from raft_tpu.nemesis.program import (Clause, clock_skew, crash_storm,
-                                      describe, flaky_link, from_json,
-                                      gray_mix, partition_wave, program,
-                                      program_hash, slow_follower, to_json,
-                                      wan_delay)
+from raft_tpu.nemesis.program import (Clause, clock_skew,
+                                      compaction_pressure, crash_storm,
+                                      describe, disk_full_follower,
+                                      flaky_link, from_json, gray_mix,
+                                      partition_wave, pressure_mix,
+                                      program, program_hash, slow_follower,
+                                      to_json, wan_delay)
 
-__all__ = ["Clause", "clock_skew", "crash_storm", "describe",
-           "flaky_link", "from_json", "gray_mix", "partition_wave",
-           "program", "program_hash", "slow_follower", "to_json",
-           "wan_delay"]
+__all__ = ["Clause", "clock_skew", "compaction_pressure", "crash_storm",
+           "describe", "disk_full_follower", "flaky_link", "from_json",
+           "gray_mix", "partition_wave", "pressure_mix", "program",
+           "program_hash", "slow_follower", "to_json", "wan_delay"]

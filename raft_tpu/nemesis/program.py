@@ -35,6 +35,8 @@ KIND_NAMES = {
     _r.NEM_SKEW: "clock_skew",
     _r.NEM_STORM: "crash_storm",
     _r.NEM_WAVE: "partition_wave",
+    _r.NEM_DISK: "disk_full_follower",
+    _r.NEM_COMPACT: "compaction_pressure",
 }
 KIND_IDS = {v: k for k, v in KIND_NAMES.items()}
 
@@ -123,6 +125,29 @@ def partition_wave(t0, t1, period=32, width=12, leak_p=1.0, groups=1.0):
     return _clause(_r.NEM_WAVE, t0, t1, groups, leak_p, a=period, b=width)
 
 
+def disk_full_follower(t0, t1, p=0.8, epoch=8, groups=1.0):
+    """Disk-full follower (r20, DESIGN.md §19): ONE hash-chosen node
+    per participating group exhausts its persistence budget during
+    `epoch`-tick sub-epochs firing w.p. `p` — every local append on it
+    fails while full, so entries are not durable and are never acked
+    (the AE reply stops at the durable prefix and the leader's
+    retransmission loop is the NACK/throttle path)."""
+    if epoch < 1:
+        raise ValueError("epoch must be >= 1")
+    return _clause(_r.NEM_DISK, t0, t1, groups, p, a=epoch)
+
+
+def compaction_pressure(t0, t1, p=0.5, epoch=8, groups=1.0):
+    """Compaction pressure (r20, DESIGN.md §19): per node per
+    `epoch`-tick sub-epoch, w.p. `p`, the phase-A snapshot/compaction
+    step is delayed — the log_cap ring genuinely fills and the window
+    invariant becomes a runtime backpressure path that throttles
+    replication instead of deadlocking."""
+    if epoch < 1:
+        raise ValueError("epoch must be >= 1")
+    return _clause(_r.NEM_COMPACT, t0, t1, groups, p, a=epoch)
+
+
 def program(*clauses) -> tuple:
     """Assemble clauses into a program: assign fresh cids to builder
     output (positional), keep explicit cids (a shrunk program re-built
@@ -151,6 +176,19 @@ def gray_mix(n_ticks: int, t0: int = 0) -> tuple:
     return program(
         slow_follower(t0, t0 + n_ticks, p=0.7, direction=3),
         flaky_link(t0, t0 + n_ticks, p=0.9, burst_epoch=8, burst_p=0.6),
+    )
+
+
+def pressure_mix(n_ticks: int, t0: int = 0) -> tuple:
+    """THE canonical storage-pressure program (disk-full follower +
+    compaction pressure; r20, DESIGN.md §19): the graceful-degradation
+    universe shared by tests/test_nemesis.py, `kernel_sweep.py
+    --nemesis`'s pressure cells, and bench.py's knee sweep — defined
+    once so the three drivers exercise the same adversary (and the
+    manifest's `pressure_program_hash` means one thing)."""
+    return program(
+        disk_full_follower(t0, t0 + n_ticks, p=0.8, epoch=8),
+        compaction_pressure(t0, t0 + n_ticks, p=0.5, epoch=8),
     )
 
 

@@ -36,8 +36,10 @@ import json
 import numpy as np
 
 from raft_tpu.config import RaftConfig
-from raft_tpu.nemesis.program import (clock_skew, crash_storm, describe,
-                                      flaky_link, from_json, gray_mix,
+from raft_tpu.nemesis.program import (clock_skew, compaction_pressure,
+                                      crash_storm, describe,
+                                      disk_full_follower, flaky_link,
+                                      from_json, gray_mix,
                                       partition_wave, program,
                                       program_hash, slow_follower,
                                       to_json, wan_delay)
@@ -130,6 +132,13 @@ _MENUS = {
     "storm": dict(p=(0.2, 0.4, 0.6), epoch=(2, 4, 8)),
     "wave": dict(period=(8, 16, 32), width_frac=(0.25, 0.5, 0.75),
                  leak_p=(0.6, 1.0)),
+    # r20 storage-pressure kinds (DESIGN.md §19): the searcher mutates
+    # over the durability seam too — disk-full windows that park a
+    # node at its durable prefix and compaction stalls that fill the
+    # log_cap ring compose with the delivery/timer kinds above into
+    # exactly the mixed programs the hand-written tests never try.
+    "disk": dict(p=(0.5, 0.8, 1.0), epoch=(4, 8, 16)),
+    "compact": dict(p=(0.3, 0.5, 0.8), epoch=(4, 8, 16)),
 }
 
 
@@ -165,6 +174,18 @@ def _new_clause(horizon: int, seed: int, step: int):
         return crash_storm(t0, t1, p=_pick(seed, step, 14, menu["p"]),
                            epoch=_pick(seed, step, 15, menu["epoch"]),
                            groups=groups)
+    if which == "disk":
+        return disk_full_follower(t0, t1,
+                                  p=_pick(seed, step, 14, menu["p"]),
+                                  epoch=_pick(seed, step, 15,
+                                              menu["epoch"]),
+                                  groups=groups)
+    if which == "compact":
+        return compaction_pressure(t0, t1,
+                                   p=_pick(seed, step, 14, menu["p"]),
+                                   epoch=_pick(seed, step, 15,
+                                               menu["epoch"]),
+                                   groups=groups)
     period = _pick(seed, step, 14, menu["period"])
     width = max(1, int(period * _pick(seed, step, 15, menu["width_frac"])))
     return partition_wave(t0, t1, period=period, width=width,

@@ -103,6 +103,16 @@ R19_MANIFEST_KEYS = ("narrow_scalars", "narrow_ring", "narrow_mailbox",
                      "narrow_clients", "donate_scan",
                      "narrow_resident_bytes_per_group")
 
+# Manifest keys added by the r20 storage-pressure layer (the
+# bench_pressure knee protocol: max offered load meeting the p99 ack
+# SLO under the disk-pressure nemesis, the shed rate sustained there,
+# and the pressure program's hash — DESIGN.md §19) — same
+# present-from-birth / backfilled-as-null contract. Its own literal
+# (the registry idiom), proven equal to obs.manifest.PRESSURE_KEYS by
+# the auditor.
+R20_MANIFEST_KEYS = ("knee_ops_per_sec", "shed_rate_at_knee",
+                     "pressure_program_hash")
+
 # Manifest records below this group count are smoke/--quick shapes:
 # correctness drives, not trajectory points — a 1K-group quick run's
 # rate joining the 100K series would trip (or mask) the regression
@@ -154,14 +164,14 @@ def _round_of(path: str) -> int | None:
 def backfill_record(rec: dict) -> dict:
     """A manifest record normalized to the current schema: the r12
     roofline/trace keys, the r13 wire-layout keys, the r14 nemesis
-    keys, the r16 streaming keys, the r17 sharded-streaming keys, AND
-    the r19 narrow-native keys present-but-null when the record
-    predates them (same rule as the mesh keys at r08). Returns a new
-    dict."""
+    keys, the r16 streaming keys, the r17 sharded-streaming keys, the
+    r19 narrow-native keys, AND the r20 storage-pressure keys
+    present-but-null when the record predates them (same rule as the
+    mesh keys at r08). Returns a new dict."""
     out = dict(rec)
     for k in (R12_MANIFEST_KEYS + R13_MANIFEST_KEYS + R14_MANIFEST_KEYS
               + R16_MANIFEST_KEYS + R17_MANIFEST_KEYS
-              + R19_MANIFEST_KEYS):
+              + R19_MANIFEST_KEYS + R20_MANIFEST_KEYS):
         out.setdefault(k, None)
     return out
 

@@ -115,6 +115,18 @@ NARROW_KEYS = ("narrow_scalars", "narrow_ring", "narrow_mailbox",
                "narrow_clients", "donate_scan",
                "narrow_resident_bytes_per_group")
 
+# r20 storage-pressure keys (DESIGN.md §19): the graceful-degradation
+# headline of the bench_pressure knee protocol — the max offered load
+# (ops/s) meeting the p99 ack SLO under the disk-pressure nemesis, the
+# shed rate the admission queue sustained there, and the hash of the
+# pressure program the sweep ran under (pairs the knee with its exact
+# adversary like NEMESIS_KEYS pairs rates). Present-but-null from
+# birth (a null = "no pressure sweep", which every pre-r20 record
+# trivially satisfies); obs.history backfills them on read, proven
+# both directions by the auditor's manifest pass.
+PRESSURE_KEYS = ("knee_ops_per_sec", "shed_rate_at_knee",
+                 "pressure_program_hash")
+
 
 def config_hash(cfg) -> str:
     """Stable short hash of the SEMANTIC config — two runs with equal
@@ -171,7 +183,7 @@ def emit_manifest(segment: str, cfg, device: str | None = None,
            "mesh_shape": None, "groups_per_device": None,
            **{k: None for k in ROOFLINE_KEYS + PACKING_KEYS
               + NEMESIS_KEYS + STREAM_KEYS + STREAM_MESH_KEYS
-              + NARROW_KEYS}}
+              + NARROW_KEYS + PRESSURE_KEYS}}
     rec.update(fields)
     path = path or os.environ.get(MANIFEST_ENV) or DEFAULT_PATH
     if path != "-":

@@ -109,7 +109,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_tpu.clients.state import CLIENT_LEAVES, ClientState
+from raft_tpu.clients.state import ClientState, active_client_leaves
 from raft_tpu.clients import workload as _workload
 from raft_tpu.config import (CONFIG_FLAG, SESSION_FLAG, SESSION_SEQ_MASK,
                              SESSION_SEQ_SHIFT, SESSION_SID_MASK,
@@ -198,7 +198,7 @@ def _wire_state_leaves(cfg: RaftConfig) -> list:
                                         if f == "is_req_snap_sessions"
                                         else 1)))
     if cfg.clients_u32:
-        out.extend((f, cfg.client_slots) for f in CLIENT_LEAVES)
+        out.extend((f, cfg.client_slots) for f in active_client_leaves(cfg))
     out.append(("alive_prev", 1 if cfg.pack_bools else cfg.k))
     out.append(("group_id", 1))
     return out
@@ -237,7 +237,7 @@ def _vmem_state_words(cfg: RaftConfig) -> int:
         words += cfg.k * cfg.k * (cfg.client_slots
                                   if f == "is_req_snap_sessions" else 1)
     if cfg.clients_u32:
-        words += len(CLIENT_LEAVES) * cfg.client_slots
+        words += len(active_client_leaves(cfg)) * cfg.client_slots
     scalar_lanes = len(_active_metric_leaves(cfg)) - _n_row_metrics(cfg)
     return words + cfg.k + 1 + scalar_lanes
 
@@ -746,6 +746,16 @@ def _on_ae_req(cfg, ns, out, g, i, src: int, ib, gl):
     hi = m_prev + j0
     last_index = ns.last_index
     stopped = proceed & (g < 0)                 # all-false, constant-free
+    # Storage pressure (r20, DESIGN.md §19), mirroring step._on_ae_req:
+    # a disk-full node's appends fail — `hi` stops at the durable
+    # prefix (the partial-ack NACK), while matching entries, in-place
+    # term rewrites and divergent-suffix truncation stay live. The
+    # mask is pure hash compares on runtime coordinates (Mosaic-legal;
+    # statically absent with no disk clause).
+    df = None
+    if cfg.nem_disk:
+        df = jrng.nem_disk_full(cfg.seed, cfg.nem_disk, g, i,
+                                gl[2], cfg.k)
     write_t, write_p, slots = [], [], []
     for j in range(cfg.max_entries_per_msg):
         idx = m_prev + 1 + j
@@ -759,6 +769,8 @@ def _on_ae_req(cfg, ns, out, g, i, src: int, ib, gl):
         diverge = in_log & ~same_t & ~same_p
         need_append = (act & ~in_log) | diverge
         room = (idx - ns.snap_index) <= cfg.log_cap
+        if df is not None:
+            room = room & ~df
         do_append = need_append & room
         write_t.append(same_p | do_append)
         write_p.append(do_append)
@@ -1077,8 +1089,13 @@ def _phase_t(cfg, ns, out, g, i, t):
     return _start_election_masked(cfg, ns, out, g, i, timeout, t)
 
 
-def _phase_c(cfg, ns, g, t, csub=None, cpay=None):
+def _phase_c(cfg, ns, g, i, t, csub=None, cpay=None):
     lead = ns.role == LEADER
+    # Disk-full leaders append nothing (r20) — step._phase_c's mask,
+    # folded into every room check below.
+    df = None
+    if cfg.nem_disk:
+        df = jrng.nem_disk_full(cfg.seed, cfg.nem_disk, g, i, t, cfg.k)
 
     if cfg.read_every:
         # step._phase_c read registration: START of phase C, pre-append
@@ -1107,6 +1124,8 @@ def _phase_c(cfg, ns, g, t, csub=None, cpay=None):
                 & (_term_at(cfg, ns, ns.commit) == ns.term))
         idx = ns.last_index + 1
         room = (idx - ns.snap_index) <= cfg.log_cap
+        if df is not None:
+            room = room & ~df
         do = lead & fires & gate & room
         sl = _slot(cfg, idx)
         ns = ns._replace(
@@ -1127,6 +1146,8 @@ def _phase_c(cfg, ns, g, t, csub=None, cpay=None):
         for sl in range(cfg.client_slots):
             idx = last_index + 1
             room = (idx - ns.snap_index) <= cfg.log_cap
+            if df is not None:
+                room = room & ~df
             want = lead & (csub[sl] != 0)
             do = want & room & ~stopped
             s = _slot(cfg, idx)
@@ -1137,6 +1158,8 @@ def _phase_c(cfg, ns, g, t, csub=None, cpay=None):
     for _ in range(cfg.cmds_per_tick):
         idx = last_index + 1
         room = (idx - ns.snap_index) <= cfg.log_cap
+        if df is not None:
+            room = room & ~df
         do = lead & room & ~stopped
         payload = jrng.client_payload(cfg.seed, g, ns.term, idx)
         s = _slot(cfg, idx)
@@ -1169,7 +1192,7 @@ def _commit_candidate_voters(cfg, match_index, last_index, i, voters):
     return out
 
 
-def _phase_a(cfg, ns, i):
+def _phase_a(cfg, ns, g, i, t):
     if cfg.reconfig_u32 == 0:
         n = _commit_candidate(cfg, ns.match_index, ns.last_index, i)
     else:
@@ -1219,6 +1242,11 @@ def _phase_a(cfg, ns, i):
         applied = jnp.where(act, idx, applied)
 
     compact = (commit - ns.snap_index) >= cfg.compact_every
+    if cfg.nem_compact:
+        # Compaction pressure (r20, DESIGN.md §19): step._phase_a's
+        # delayed-snapshot gate, hash compares only (Mosaic-legal).
+        compact = compact & ~jrng.nem_compact_block(
+            cfg.seed, cfg.nem_compact, g, i, t)
     sess = {}
     if cfg.clients_u32:
         sess = dict(session_seq=table,
@@ -1300,8 +1328,8 @@ def _node_tick(cfg, t, ns: PerNode, inbox, g, i, glog_t, glog_p,
         for src in range(cfg.k):
             ns, out = handler(cfg, ns, out, g, i, src, inbox, gl)
     ns, out = _phase_t(cfg, ns, out, g, i, t)
-    ns = _phase_c(cfg, ns, g, t, csub, cpay)
-    ns = _phase_a(cfg, ns, i)
+    ns = _phase_c(cfg, ns, g, i, t, csub, cpay)
+    ns = _phase_a(cfg, ns, g, i, t)
     # Outbox bools leave the per-node step widened to i32: the vmap
     # out_axes=1 stacking transposes the node axis, and Mosaic's i1
     # relayout path materializes mask constants LLO cannot build.
@@ -1720,7 +1748,7 @@ def _to_kstate(cfg, st: State):
         a = jnp.moveaxis(getattr(st.mailbox, f), 0, -1)
         out.append(_fold_g(_widen_klane(a)))
     if cfg.clients_u32:
-        for f in CLIENT_LEAVES:
+        for f in active_client_leaves(cfg):
             out.append(_fold_g(_widen_klane(
                 jnp.moveaxis(getattr(st.clients, f), 0, -1))))
     out.append(_fold_g(jnp.transpose(st.alive_prev, (1, 0)).astype(I32)))
@@ -1749,7 +1777,7 @@ def _from_kstate(cfg, flat, g: int) -> State:
     clients = None
     if cfg.clients_u32:
         clients = ClientState(**{f: jnp.moveaxis(next(it), -1, 0)
-                                 for f in CLIENT_LEAVES})
+                                 for f in active_client_leaves(cfg)})
     alive = jnp.transpose(next(it), (1, 0)).astype(BOOL)
     gid = next(it)
     return State(nodes=PerNode(**nd), mailbox=Mailbox(**md),
@@ -1779,7 +1807,7 @@ def _unpacked_names(cfg):
     """Wire-leaf names of the UNPACKED state section, in r12 registry
     order — the list `_to_kstate` emits and the kernel body consumes."""
     return ([f for f, _ in _node_leaves(cfg)] + list(_mb_fields(cfg))
-            + (list(CLIENT_LEAVES) if cfg.clients_u32 else [])
+            + (list(active_client_leaves(cfg)) if cfg.clients_u32 else [])
             + ["alive_prev", "group_id"])
 
 
@@ -1959,7 +1987,8 @@ def _build_kernel(cfg, n_ticks, with_flight):
             md[f] = a
         cl = None
         if cfg.clients_u32:
-            cl = ClientState(**{f: next(it) for f in CLIENT_LEAVES})
+            cl = ClientState(**{f: next(it)
+                                for f in active_client_leaves(cfg)})
         alive_prev = next(it) != 0
         g = next(it)
         tail = iter(in_refs[n_state:])
@@ -2014,7 +2043,7 @@ def _build_kernel(cfg, n_ticks, with_flight):
             outs.append(a.astype(I32)
                         if a.dtype in (jnp.bool_, jnp.uint32) else a)
         if cfg.clients_u32:
-            outs.extend(getattr(cl, f) for f in CLIENT_LEAVES)
+            outs.extend(getattr(cl, f) for f in active_client_leaves(cfg))
         outs.append(alive_prev.astype(I32))
         outs.append(g)
         ot = iter(out_refs)

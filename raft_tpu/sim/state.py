@@ -388,12 +388,15 @@ def narrow_spec(cfg: RaftConfig) -> dict:
         if cfg.k <= 16:
             spec["mailbox.is_req_snap_voters"] = U16
     if cfg.narrow_clients and cfg.clients_u32:
-        from raft_tpu.clients.state import NARROW_CLIENT_SPEC
+        from raft_tpu.clients.state import (NARROW_CLIENT_SPEC,
+                                            active_client_leaves)
         spec["nodes.session_seq"] = I16
         spec["nodes.snap_session_seq"] = I16
         spec["mailbox.is_req_snap_sessions"] = I16
-        for n, dt in NARROW_CLIENT_SPEC.items():
-            spec[f"clients.{n}"] = dt
+        # Iterate the cfg's ACTIVE leaves: the admission-gated shed
+        # lane must not map a spec entry with no matching leaf.
+        for n in active_client_leaves(cfg):
+            spec[f"clients.{n}"] = NARROW_CLIENT_SPEC[n]
     return spec
 
 

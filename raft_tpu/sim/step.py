@@ -317,6 +317,17 @@ def _on_ae_req(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
     hi = m_prev + j0
     last_index = ns.last_index
     stopped = jnp.zeros((), BOOL)
+    # Storage pressure (r20, DESIGN.md §19): a disk-full node's appends
+    # all fail — non-durable entries are never acked, so `hi` (hence
+    # the match reply and the commit clamp) stops at the durable
+    # prefix and the leader's retransmission is the NACK loop. Matching
+    # entries still advance `hi`, in-place term rewrites (same_p) stay
+    # live, and a divergent suffix is still truncated — mirroring the
+    # oracle, where only `_append` itself consults the budget.
+    df = jnp.zeros((), BOOL)
+    if cfg.nem_disk:
+        df = jrng.nem_disk_full(cfg.seed, cfg.nem_disk, g, i,
+                                gl[2], cfg.k)
     # Stage 1 — decide: per-entry scalar chain. Reads go to the ORIGINAL
     # log arrays: the E entries address E consecutive absolute indices,
     # whose ring slots are pairwise distinct (E <= L, config invariant),
@@ -333,7 +344,7 @@ def _on_ae_req(cfg, ns, out, g, i, src: int, ib: Mailbox, gl):
         same_p = in_log & ~same_t & (_lget(ns.log_payload, s) == ent_p[j])
         diverge = in_log & ~same_t & ~same_p   # truncate, then append
         need_append = (act & ~in_log) | diverge
-        room = (idx - ns.snap_index) <= cfg.log_cap
+        room = ((idx - ns.snap_index) <= cfg.log_cap) & ~df
         do_append = need_append & room
         write_t.append(same_p | do_append)
         write_p.append(do_append)
@@ -685,14 +696,19 @@ def _phase_t(cfg, ns, out, g, i, t):
 # ----------------------------------------------------------------- phase C
 
 
-def _phase_c(cfg, ns, g, t, csub=None, cpay=None):
+def _phase_c(cfg, ns, g, i, t, csub=None, cpay=None):
     """`Node.phase_c`: scheduled read registration (DESIGN.md §2c),
     scheduled membership proposal (DESIGN.md §2b), then open-loop
     client session appends (DESIGN.md §10 — `csub`/`cpay` are the
     [S] submit pulses and payloads raised by the PREVIOUS tick's
     client transition; None with clients off), then fire-hose command
-    appends."""
+    appends. A disk-full leader (r20, DESIGN.md §19) appends nothing —
+    every site below folds the pressure mask into its room check, the
+    batched form of the oracle's `_append` budget gate."""
     lead = ns.role == LEADER
+    df = jnp.zeros((), BOOL)
+    if cfg.nem_disk:
+        df = jrng.nem_disk_full(cfg.seed, cfg.nem_disk, g, i, t, cfg.k)
 
     if cfg.read_every:
         # `Node._maybe_schedule_read`: START of phase C, so the read
@@ -718,7 +734,7 @@ def _phase_c(cfg, ns, g, t, csub=None, cpay=None):
                 & (cfg_index <= ns.commit)
                 & (_term_at(cfg, ns, ns.commit) == ns.term))
         idx = ns.last_index + 1
-        room = (idx - ns.snap_index) <= cfg.log_cap
+        room = ((idx - ns.snap_index) <= cfg.log_cap) & ~df
         do = lead & fires & gate & room
         s = _slot(cfg, idx)
         ns = ns._replace(
@@ -738,7 +754,7 @@ def _phase_c(cfg, ns, g, t, csub=None, cpay=None):
         # transient dual leaders are safe by the exactly-once fold.
         for sl in range(cfg.client_slots):
             idx = last_index + 1
-            room = (idx - ns.snap_index) <= cfg.log_cap
+            room = ((idx - ns.snap_index) <= cfg.log_cap) & ~df
             want = lead & (csub[sl] != 0)
             do = want & room & ~stopped
             s = _slot(cfg, idx)
@@ -748,7 +764,7 @@ def _phase_c(cfg, ns, g, t, csub=None, cpay=None):
             stopped = stopped | (want & ~room)
     for _ in range(cfg.cmds_per_tick):
         idx = last_index + 1
-        room = (idx - ns.snap_index) <= cfg.log_cap
+        room = ((idx - ns.snap_index) <= cfg.log_cap) & ~df
         do = lead & room & ~stopped
         payload = jrng.client_payload(cfg.seed, g, ns.term, idx)
         s = _slot(cfg, idx)
@@ -763,9 +779,10 @@ def _phase_c(cfg, ns, g, t, csub=None, cpay=None):
 # ----------------------------------------------------------------- phase A
 
 
-def _phase_a(cfg, ns, i):
+def _phase_a(cfg, ns, g, i, t):
     """`Node.phase_a`: voters-aware commit advance, removed-leader
-    step-down, apply, compact."""
+    step-down, apply, compact. `g`/`t` feed only the statically-gated
+    compaction-pressure clauses (r20, DESIGN.md §19)."""
     if cfg.reconfig_u32 == 0:
         # Static fast path: full config, compile-time majority; the
         # removed-leader demotion branch cannot fire and is elided.
@@ -823,6 +840,13 @@ def _phase_a(cfg, ns, i):
         applied = jnp.where(act, idx, applied)
 
     compact = (commit - ns.snap_index) >= cfg.compact_every
+    if cfg.nem_compact:
+        # Compaction pressure (r20, DESIGN.md §19): a blocked node's
+        # snapshot step is delayed; the log_cap ring genuinely fills
+        # and the append-site room checks become the runtime
+        # backpressure path that throttles replication.
+        compact = compact & ~jrng.nem_compact_block(
+            cfg.seed, cfg.nem_compact, g, i, t)
     sess = {}
     if cfg.clients_u32:
         # Compaction folds the live table into the snapshot (node.py
@@ -890,8 +914,8 @@ def _node_tick(cfg, t, ns: PerNode, inbox: Mailbox, g, i, glog_t, glog_p,
         for src in range(cfg.k):
             ns, out = handler(cfg, ns, out, g, i, src, inbox, gl)
     ns, out = _phase_t(cfg, ns, out, g, i, t)
-    ns = _phase_c(cfg, ns, g, t, csub, cpay)
-    ns = _phase_a(cfg, ns, i)
+    ns = _phase_c(cfg, ns, g, i, t, csub, cpay)
+    ns = _phase_a(cfg, ns, g, i, t)
     return ns, out
 
 

@@ -228,3 +228,39 @@ def nem_deadline_extra(seed, prog, g, i, t):
         raise ValueError("nem_deadline_extra: no timing clause in the "
                          "program — gate the call on cfg.nem_skew")
     return extra
+
+
+def nem_disk_full(seed, prog, g, i, t, k: int):
+    """u32-lane twin of utils.rng.nem_disk_full (r20, DESIGN.md §19)."""
+    full = None
+    for c in prog:
+        kind, t0, t1, group_u32, p_u32, a, b, cid = c
+        if kind not in _r.NEM_DISK_KINDS:
+            continue
+        target = hash_u32(seed, _r.TAG_NEM_NODE, cid, g) % jnp.uint32(k)
+        hit = (_nem_active(seed, c, g, t)
+               & (_u32(i) == target)
+               & (hash_u32(seed, _r.TAG_NEM_DISK, cid, g,
+                           _u32(t) // jnp.uint32(a)) < jnp.uint32(p_u32)))
+        full = hit if full is None else full | hit
+    if full is None:
+        raise ValueError("nem_disk_full: no disk clause in the program — "
+                         "gate the call on cfg.nem_disk")
+    return full
+
+
+def nem_compact_block(seed, prog, g, i, t):
+    """u32-lane twin of utils.rng.nem_compact_block (r20)."""
+    blocked = None
+    for c in prog:
+        kind, t0, t1, group_u32, p_u32, a, b, cid = c
+        if kind not in _r.NEM_COMPACT_KINDS:
+            continue
+        hit = (_nem_active(seed, c, g, t)
+               & (hash_u32(seed, _r.TAG_NEM_COMPACT, cid, g, i,
+                           _u32(t) // jnp.uint32(a)) < jnp.uint32(p_u32)))
+        blocked = hit if blocked is None else blocked | hit
+    if blocked is None:
+        raise ValueError("nem_compact_block: no compaction clause in the "
+                         "program — gate the call on cfg.nem_compact")
+    return blocked

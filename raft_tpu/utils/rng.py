@@ -40,6 +40,10 @@ TAG_NEM_LINK = 15    # per-clause per-link per-tick delivery draw
 TAG_NEM_CRASH = 16   # crash-storm epoch draws
 TAG_NEM_SIDE = 17    # partition-wave side assignment (per period)
 TAG_NEM_BURST = 18   # flaky-link burst-epoch draws
+# Storage-pressure seam (r20, DESIGN.md §19): the two clause kinds the
+# r14 compiler could not express because the tick had no storage seam.
+TAG_NEM_DISK = 19     # disk-full-follower sub-epoch draws
+TAG_NEM_COMPACT = 20  # compaction-pressure sub-epoch draws
 
 
 # ------------------------------------------------------ nemesis programs
@@ -76,21 +80,38 @@ TAG_NEM_BURST = 18   # flaky-link burst-epoch draws
 #              g ticks after g-1); inside the window cross-side links
 #              (sides re-drawn each period) drop w.p. p_u32 — p_u32
 #              below 1.0 is a leaky, gray partition.
+#   NEM_DISK   disk-full follower (r20, DESIGN.md §19): the hash-chosen
+#              target node's persistence budget is exhausted during
+#              sub-epochs of a ticks firing w.p. p_u32 — every local
+#              append fails (the entry is NOT durable, so it is never
+#              acked; the leader's retransmission loop is the
+#              backpressure). b unused.
+#   NEM_COMPACT compaction pressure (r20): each node independently has
+#              its snapshot/compaction step blocked during sub-epochs
+#              of a ticks firing w.p. p_u32 — the log_cap ring
+#              genuinely fills and the window invariant becomes a
+#              runtime backpressure path. b unused.
 NEM_SLOW = 1
 NEM_FLAKY = 2
 NEM_WAN = 3
 NEM_SKEW = 4
 NEM_STORM = 5
 NEM_WAVE = 6
-NEM_KINDS = (NEM_SLOW, NEM_FLAKY, NEM_WAN, NEM_SKEW, NEM_STORM, NEM_WAVE)
+NEM_DISK = 7
+NEM_COMPACT = 8
+NEM_KINDS = (NEM_SLOW, NEM_FLAKY, NEM_WAN, NEM_SKEW, NEM_STORM, NEM_WAVE,
+             NEM_DISK, NEM_COMPACT)
 # Which seam each kind compiles onto — RaftConfig.nem_link / nem_crash
-# / nem_skew filter by these, and the engines statically gate each seam
-# on its filtered subprogram being non-empty. Every kind MUST appear in
-# exactly one tuple (analysis.contracts.nemesis_problems proves the
-# partition, so a new kind cannot be silently ignored by every seam).
+# / nem_skew / nem_disk / nem_compact filter by these, and the engines
+# statically gate each seam on its filtered subprogram being non-empty.
+# Every kind MUST appear in exactly one tuple
+# (analysis.contracts.nemesis_problems proves the partition, so a new
+# kind cannot be silently ignored by every seam).
 NEM_LINK_KINDS = (NEM_SLOW, NEM_FLAKY, NEM_WAN, NEM_WAVE)
 NEM_CRASH_KINDS = (NEM_STORM,)
 NEM_TIMING_KINDS = (NEM_SKEW,)
+NEM_DISK_KINDS = (NEM_DISK,)
+NEM_COMPACT_KINDS = (NEM_COMPACT,)
 
 
 def mix32(x: int) -> int:
@@ -277,3 +298,51 @@ def nem_deadline_extra(seed, prog, g, i, t):
         raise ValueError("nem_deadline_extra: no timing clause in the "
                          "program — gate the call on cfg.nem_skew")
     return extra
+
+
+def nem_disk_full(seed, prog, g, i, t, k):
+    """True iff an active disk-full clause exhausts node i's
+    persistence budget at tick t (r20, DESIGN.md §19). The target node
+    is hash-chosen per (clause, group) like NEM_SLOW's, so a quorum of
+    healthy disks usually survives; fullness fires per sub-epoch of a
+    ticks w.p. p_u32. A full disk fails every local append — the entry
+    is not durable and must never be acked."""
+    relevant = False
+    full = False
+    for c in prog:
+        kind, t0, t1, group_u32, p_u32, a, b, cid = c
+        if kind not in NEM_DISK_KINDS:
+            continue
+        relevant = True
+        if not _nem_active(seed, c, g, t):
+            continue
+        target = hash_u32(seed, TAG_NEM_NODE, cid, g) % k
+        if (i == target
+                and hash_u32(seed, TAG_NEM_DISK, cid, g, t // a) < p_u32):
+            full = True
+    if not relevant:
+        raise ValueError("nem_disk_full: no disk clause in the program — "
+                         "gate the call on cfg.nem_disk")
+    return full
+
+
+def nem_compact_block(seed, prog, g, i, t):
+    """True iff an active compaction-pressure clause blocks node i's
+    snapshot/compaction step at tick t (r20, DESIGN.md §19): per-node
+    per-sub-epoch-of-a-ticks draws under p_u32, so the log_cap ring
+    genuinely fills while the clause holds."""
+    relevant = False
+    blocked = False
+    for c in prog:
+        kind, t0, t1, group_u32, p_u32, a, b, cid = c
+        if kind not in NEM_COMPACT_KINDS:
+            continue
+        relevant = True
+        if (_nem_active(seed, c, g, t)
+                and hash_u32(seed, TAG_NEM_COMPACT, cid, g, i,
+                             t // a) < p_u32):
+            blocked = True
+    if not relevant:
+        raise ValueError("nem_compact_block: no compaction clause in the "
+                         "program — gate the call on cfg.nem_compact")
+    return blocked

@@ -163,6 +163,63 @@ def leader_completeness(role, term, commit, last_index, snap_index,
     return ok
 
 
+def commit_durability(commit, last_index, snap_index, log_payload,
+                      log_cap: int, xp=np):
+    """The commit rule checked against lossy persistence (r20,
+    DESIGN.md §19): every index in any node's committed prefix that is
+    still visible in that node's window is HELD by at least a majority
+    of the k nodes. Node `a` holds absolute index i when either
+
+    - i <= snap_index_a: a's snapshot folded it (snapshots cover only
+      committed prefixes, and commit identity pins one payload per
+      index, so a compacted copy is a durable copy of THE entry), or
+    - i sits live in a's window on the same ring lane (slot identity:
+      i lives at slot (i-1) % L on every node) with the SAME payload —
+      a conflicting uncommitted entry at i does not count.
+
+    Why sound point-in-time: an index commits only after a majority
+    durably acked it (under storage pressure a disk-full follower's
+    AE reply stops at its durable prefix — entries that did not
+    persist are never acked); each acker's term is >= the committing
+    term from that point on, so no stale leader can make it truncate,
+    and any leader of a later term holds the committed prefix (Leader
+    Completeness) so conflict resolution never deletes it; restart
+    keeps the durable log; compaction converts holding-in-window to
+    holding-in-snapshot. Indices below the observing node's OWN
+    snap_index are structurally out of view (they were checked while
+    live). Payloads (not terms) compare because takeover re-terms the
+    top entry in place.
+
+    This is exactly what ack-without-persist breaks: a follower that
+    acks entries its storage rejected lets the leader's match tally
+    commit an index held by fewer than a majority. Majority is over
+    the FULL k membership, which is exact in the model checker's
+    reconfig-off scope (verify/mcheck.py's modeled universe — this
+    predicate is checker-side, like log_matching, NOT folded into the
+    runtime safety bit: under joint-consensus reconfig a commit
+    quorum is a majority of the live voter set, which k-majority
+    over-approximates)."""
+    commit = _signed(commit, xp)
+    last_index = _signed(last_index, xp)
+    k = commit.shape[-1]
+    majority = k // 2 + 1
+    absidx = slot_abs_index(snap_index, log_cap, xp)      # [..., K, L]
+    snap = _signed(snap_index, xp)
+    ok = xp.ones(commit.shape[:-1], dtype=bool)
+    for b in range(k):
+        idx_b = absidx[..., b, :]                         # [..., L]
+        live = idx_b <= commit[..., b, None]   # committed, in b's window
+        cnt = xp.zeros(idx_b.shape, dtype=np.int32)
+        for a in range(k):
+            held = ((idx_b <= snap[..., a, None])
+                    | ((absidx[..., a, :] == idx_b)
+                       & (idx_b <= last_index[..., a, None])
+                       & (log_payload[..., a, :] == log_payload[..., b, :])))
+            cnt = cnt + held.astype(np.int32)
+        ok = ok & xp.all(xp.where(live, cnt >= majority, True), axis=-1)
+    return ok
+
+
 def client_safety(applied, session_seq, done, xp=np):
     """The r09 exactly-once invariant (DESIGN.md §10): nodes with the
     same applied prefix hold element-identical (sid -> seq) dedup

@@ -35,6 +35,13 @@ schedules can produce, so "clean here" implies "clean there"):
 - Adversarial crashes: any alive-vector per tick with at most
   `max_dead` nodes down (restart-on-revive through the real
   `Node.restart`).
+- Adversarial storage pressure (r20): with `max_disk > 0` the
+  scheduler forces any subset of at most `max_disk` nodes'
+  persistence budgets empty for the tick (`Node.disk_override` — the
+  seam `_append` consults before the hashed disk_full_follower
+  schedule), so every hash-drawn disk-full pattern is one override
+  schedule among those enumerated, and `commit_durability` is checked
+  against the full adversarial lossy-persistence space.
 - Time-homogeneous scope: reconfig/reads/transfer/nemesis are off and
   fault hashes are scheduler-replaced, so transitions do not depend on
   the absolute tick — state dedup across depths is sound, and
@@ -51,7 +58,10 @@ The exactly-once client universe (`Bounds.sessions=True`) drives
 self-believed leader a fresh command or a duplicate retry of the last
 issued seq — the dual-leader double-append scenarios the r09 dedup
 exists for — and `client_safety` is checked against the ghost issued
-frontier.
+frontier. With `Bounds.admission=True` it may also hand a leader a
+SHED arrival (`Node.admit_and_propose` with shed=True, r20): the
+definitive-reject contract means the seq is never issued, so a node
+that applies it anyway trips `client_safety`'s issued-frontier clause.
 """
 
 from __future__ import annotations
@@ -101,7 +111,9 @@ class Bounds:
     max_index: int = 4        # prune states whose any last_index exceeds this
     max_dead: int = 1         # simultaneously-crashed cap per tick
     max_pulses: int = 1       # nodes the timeout adversary fires per tick
+    max_disk: int = 0         # simultaneously disk-full cap per tick (r20)
     sessions: bool = False    # exactly-once client universe (cmds off)
+    admission: bool = False   # shed arrivals in the propose menu (r20)
     prevote: bool = False
     # compact_every=1 snapshots every committed entry immediately (the
     # smallest window state space). Some bug classes live in the gap
@@ -244,8 +256,13 @@ class Universe:
         cfg = self.cfg
         alive_now = list(choice["alive"])
         blocked = {tuple(l) for l in choice["blocked"]}
-        for n in self.nodes:
+        disk = set(choice.get("disk", ()))
+        for i, n in enumerate(self.nodes):
             n.now = t
+            # Adversarial storage pressure: the override seam every
+            # `_append` consults (set for ALL nodes every tick, so no
+            # stale override survives a restore).
+            n.disk_override = i in disk
         for i, n in enumerate(self.nodes):
             if alive_now[i] and not self.alive_prev[i]:
                 n.restart()
@@ -270,9 +287,16 @@ class Universe:
         if prop is not None:
             i, kind = prop
             n = self.nodes[i]
-            seq = self.issued + 1 if kind == "new" else self.issued
+            # "new"/"shed" arrive with the next unissued seq; "dup"
+            # retries the last issued one. Routed through the r20
+            # admission seam: a shed arrival is a definitive reject, so
+            # `issued` NEVER advances for it (only an accepted "new"
+            # does) — a node that applies a shed command runs ahead of
+            # the ghost frontier and client_safety kills it.
+            seq = self.issued if kind == "dup" else self.issued + 1
             if seq >= 0 and n.role == LEADER and alive_now[i]:
-                if n.propose_seq(0, seq, seq) is not None and kind == "new":
+                r = n.admit_and_propose(0, seq, seq, shed=(kind == "shed"))
+                if r is not None and kind == "new":
                     self.issued = seq
         for i, n in enumerate(self.nodes):
             if alive_now[i]:
@@ -393,6 +417,12 @@ class Universe:
             "leader_completeness": inv.leader_completeness(
                 v["role"], v["term"], v["commit"], v["last_index"],
                 v["snap_index"], v["log_payload"], cfg.log_cap),
+            # Checker-side like log_matching (not in the runtime fold):
+            # the commit rule vs lossy persistence (r20) — every
+            # committed index still in view is held by a k-majority.
+            "commit_durability": inv.commit_durability(
+                v["commit"], v["last_index"], v["snap_index"],
+                v["log_payload"], cfg.log_cap),
         }
         if self.bounds.sessions:
             table = np.array([[[n.sessions.get(0, -1)]
@@ -450,6 +480,9 @@ class Universe:
         pulse_opts = [()]
         for r in range(1, b.max_pulses + 1):
             pulse_opts.extend(itertools.combinations(range(k), r))
+        disk_opts = [()]
+        for r in range(1, b.max_disk + 1):
+            disk_opts.extend(itertools.combinations(range(k), r))
         prop_opts: list = [None]
         if b.sessions:
             for i, n in enumerate(self.nodes):
@@ -457,14 +490,18 @@ class Universe:
                     prop_opts.append((i, "new"))
                     if self.issued >= 0:
                         prop_opts.append((i, "dup"))
+                    if b.admission:
+                        prop_opts.append((i, "shed"))
         for alive in alive_opts:
             for blocked in blocked_opts:
                 for pulse in pulse_opts:
                     if any(not alive[i] for i in pulse):
                         continue   # a dead node cannot time out
-                    for prop in prop_opts:
-                        yield {"alive": alive, "blocked": blocked,
-                               "pulse": pulse, "propose": prop}
+                    for disk in disk_opts:
+                        for prop in prop_opts:
+                            yield {"alive": alive, "blocked": blocked,
+                                   "pulse": pulse, "disk": disk,
+                                   "propose": prop}
 
 
 def _msg_from_tuple(t: tuple):
@@ -660,7 +697,7 @@ def check(bounds: Bounds, node_cls=Node, log: Callable = None,
 
 def _quiet(choice_alive_k: int) -> dict:
     return {"alive": tuple([True] * choice_alive_k), "blocked": (),
-            "pulse": (), "propose": None}
+            "pulse": (), "disk": (), "propose": None}
 
 
 def hunt(bounds: Bounds, node_cls=Node, episodes: int = 2000,
@@ -688,6 +725,7 @@ def hunt(bounds: Bounds, node_cls=Node, episodes: int = 2000,
         sched = []
         down = None          # sticky crash
         blocked = ()         # sticky directional block
+        full = None          # sticky disk-full node (r20)
         for t in range(horizon):
             c = dict(_quiet(k))
             if down is not None and r.random() < 0.65:
@@ -712,6 +750,18 @@ def hunt(bounds: Bounds, node_cls=Node, episodes: int = 2000,
             else:
                 blocked = ()
             c["blocked"] = blocked
+            # Sticky disk pressure: a full disk stays full across ticks
+            # with high probability, like the crash/block faults — the
+            # durability bugs need the budget held across an AE round
+            # trip, which per-tick sampling essentially never produces.
+            if full is not None and r.random() < 0.70:
+                pass                            # stays full
+            elif bounds.max_disk and r.random() < 0.30:
+                full = r.randrange(k)
+            else:
+                full = None
+            if full is not None:
+                c["disk"] = (full,)
             if r.random() < 0.45:
                 c["pulse"] = (r.randrange(k),)
             if bounds.sessions and r.random() < 0.5:
@@ -720,6 +770,8 @@ def hunt(bounds: Bounds, node_cls=Node, episodes: int = 2000,
                 if lead:
                     kind = "dup" if (uni.issued >= 0
                                      and r.random() < 0.5) else "new"
+                    if bounds.admission and r.random() < 0.35:
+                        kind = "shed"
                     c["propose"] = (r.choice(lead), kind)
             sched.append(c)
             try:
@@ -758,8 +810,8 @@ def shrink_schedule(bounds: Bounds, node_cls, sched):
     sched = list(sched[:hit[0] + 1])
     quiet = _quiet(bounds.k)
     for t in range(len(sched)):
-        for field in ("alive", "blocked", "pulse", "propose"):
-            if sched[t][field] == quiet[field]:
+        for field in ("alive", "blocked", "pulse", "disk", "propose"):
+            if sched[t].get(field, quiet[field]) == quiet[field]:
                 continue
             trial = [dict(c) for c in sched]
             trial[t][field] = quiet[field]
@@ -776,7 +828,8 @@ def _choice_json(c: dict) -> dict:
     return {"alive": list(c["alive"]),
             "blocked": [list(l) for l in c["blocked"]],
             "pulse": list(c["pulse"]),
-            "propose": list(c["propose"]) if c["propose"] else None}
+            "disk": list(c.get("disk", ())),
+            "propose": list(c.get("propose")) if c.get("propose") else None}
 
 
 def reproducer(result: Result, bounds: Bounds,
@@ -850,7 +903,9 @@ def replay(art: dict, node_cls=None) -> dict:
         choice = {"alive": tuple(c["alive"]),
                   "blocked": tuple(tuple(l) for l in c["blocked"]),
                   "pulse": tuple(c["pulse"]),
-                  "propose": tuple(c["propose"]) if c["propose"] else None}
+                  "disk": tuple(c.get("disk", ())),
+                  "propose": (tuple(c["propose"])
+                              if c.get("propose") else None)}
         try:
             viol = uni.tick(t, choice)
         except AssertionError:

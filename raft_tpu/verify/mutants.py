@@ -51,14 +51,15 @@ class Mutant:
 def _sched(k: int, *ticks: str) -> tuple:
     """Compact scheduler-trace literal for catalog prefixes. One string
     per tick, space-separated tokens: `xN` node N down, `pN` pulse N's
-    election timer, `bSD` block link S->D, `nN`/`uN` propose new/dup on
-    N (sessions universes). '' is the quiet tick. These are the shrunk
+    election timer, `bSD` block link S->D, `dN` node N disk-full (r20),
+    `nN`/`uN`/`sN` propose new/dup/shed on N (sessions universes; shed
+    needs Bounds.admission). '' is the quiet tick. These are the shrunk
     counterexample schedules the hunts/hand analysis found, frozen so
     the kill matrix replays them in milliseconds."""
     out = []
     for spec in ticks:
         c = {"alive": [True] * k, "blocked": (), "pulse": (),
-             "propose": None}
+             "disk": (), "propose": None}
         for tok in spec.split():
             if tok[0] == "x":
                 c["alive"][int(tok[1])] = False
@@ -66,10 +67,14 @@ def _sched(k: int, *ticks: str) -> tuple:
                 c["pulse"] += (int(tok[1]),)
             elif tok[0] == "b":
                 c["blocked"] += ((int(tok[1]), int(tok[2])),)
+            elif tok[0] == "d":
+                c["disk"] += (int(tok[1]),)
             elif tok[0] == "n":
                 c["propose"] = (int(tok[1]), "new")
             elif tok[0] == "u":
                 c["propose"] = (int(tok[1]), "dup")
+            elif tok[0] == "s":
+                c["propose"] = (int(tok[1]), "shed")
             else:
                 raise ValueError(f"bad sched token {tok!r}")
         c["alive"] = tuple(c["alive"])
@@ -295,6 +300,92 @@ class AckBeyondSent(Node):
                 1, min(self.next_index[m.src] - 1, m.match))
 
 
+class AckWithoutPersist(Node):
+    """_on_ae_req acks entries its storage rejected (r20, DESIGN.md
+    §19): when `_append` fails — window full OR the disk-full budget
+    exhausted — the reply still advances `match` over the entry, so
+    the leader's commit tally counts a copy that does not exist. The
+    real oracle's NACK rule stops `hi` at the durable prefix (the
+    partial ack IS the NACK); this mutant is the classic
+    fsync-skipped durability bug, and `commit_durability` kills it:
+    the leader commits an index held by fewer than a majority."""
+    def _on_ae_req(self, m: rpc.AppendEntriesReq):
+        if m.term > self.term:
+            self._step_down(m.term)
+        if m.term < self.term:
+            self.transport.send(rpc.AppendEntriesResp(
+                rpc.AE_RESP, self.id, m.src, term=self.term,
+                success=False, match=0))
+            return
+        self._accept_leader(m)
+        prev = m.prev_index
+        if prev > self.last_index:
+            self.transport.send(rpc.AppendEntriesResp(
+                rpc.AE_RESP, self.id, m.src, term=self.term,
+                success=False, match=self.last_index + 1))
+            return
+        if prev >= self.snap_index and self.term_at(prev) != m.prev_term:
+            ct = self.term_at(prev)
+            ci = prev
+            while ci - 1 > self.snap_index and self.term_at(ci - 1) == ct:
+                ci -= 1
+            self.transport.send(rpc.AppendEntriesResp(
+                rpc.AE_RESP, self.id, m.src, term=self.term,
+                success=False, match=ci))
+            return
+        j0 = max(0, self.snap_index - prev)
+        hi = prev + j0
+        for j in range(j0, len(m.entries)):
+            idx = prev + 1 + j
+            et, ep = m.entries[j]
+            if idx <= self.last_index:
+                if self.term_at(idx) == et:
+                    hi = idx
+                    continue
+                if self.payload_at(idx) == ep:
+                    self.log[idx - self.snap_index - 1] = (et, ep)
+                    hi = idx
+                    continue
+                if idx <= self.commit:
+                    break   # surface as divergence, not a harness crash
+                del self.log[idx - self.snap_index - 1:]
+            if not self._append(et, ep):
+                hi = idx   # BUG: acked without persisting
+                break
+            hi = idx
+        if m.leader_commit > self.commit:
+            # Clamped to last_index so the window stays structurally
+            # traversable; the durability bug is in the inflated ack.
+            self.commit = max(self.commit,
+                              min(m.leader_commit, hi, self.last_index))
+        self.transport.send(rpc.AppendEntriesResp(
+            rpc.AE_RESP, self.id, m.src, term=self.term, success=True,
+            match=hi))
+
+
+class CommitPastDurable(CommitOffByOne):
+    """phase_a tallies the optimistic SEND pointer (next_index)
+    instead of the durable-acked pointer (match_index): entries the
+    leader has merely queued for a peer count as replicated, so an
+    index commits before any follower durably holds it — the
+    send/ack confusion a pipelined replication refactor could
+    introduce. commit_durability kills it the tick the leader
+    commits its own un-acked append."""
+    def phase_a(self):
+        if self.role == LEADER:
+            voters, _ = self.current_config()
+            vals = sorted(
+                (self.last_index if p == self.id else self.next_index[p]
+                 for p in range(self.cfg.k) if (voters >> p) & 1),
+                reverse=True)   # BUG: next_index, not match_index
+            if vals:
+                n = vals[majority_of(voters) - 1]
+                n = min(n, self.last_index)
+                if n > self.commit and self.term_at(n) == self.term:
+                    self.commit = n
+        self._phase_a_tail()
+
+
 # ------------------------------------------------------ log-path mutants
 
 
@@ -514,6 +605,20 @@ class AlwaysEffective(Node):
         return True
 
 
+class ShedThenApply(Node):
+    """admit_and_propose ignores the shed verdict (r20, DESIGN.md §19):
+    an arrival the admission queue rejected — whose client got a
+    DEFINITIVE reject and will re-issue under a fresh seq, never retry
+    this one — is proposed anyway. The command commits and applies, so
+    a node's dedup table runs ahead of the issued frontier and
+    `client_safety`'s no-phantom-apply clause kills it. This is the
+    bug the definitive-reject contract exists to exclude: shed must
+    mean NOT IN THE LOG, or exactly-once accounting is fiction."""
+    def admit_and_propose(self, sid: int, seq: int, val: int, shed: bool):
+        # BUG: `if shed: return None` dropped — the reject is ignored.
+        return self.propose_seq(sid, seq, val)
+
+
 # ------------------------------------------------------------ the catalog
 
 
@@ -535,7 +640,11 @@ def _b(**kw) -> Bounds:
 MUTANTS: Tuple[Mutant, ...] = (
     Mutant("accept_stale_append", AcceptStaleAppend,
            "sim/step.py phase_d AE_REQ stale-term reject clause",
-           "leader_completeness",
+           # r20: commit_durability (the stronger commit-rule clause)
+           # catches the deposed leader's divergent install SHALLOWER
+           # than leader_completeness does — BFS reports the first
+           # violation, so the expectation follows the new frontier.
+           "commit_durability",
            _b(k=3, ticks=14, log_cap=4, compact_every=2, max_index=5,
               max_dead=0, adversary="isolate"),
            "deposed leader's AE still installs entries",
@@ -627,6 +736,26 @@ MUTANTS: Tuple[Mutant, ...] = (
            _sched(3, "p0", "", "", "", "b02 b20", "b02 b20", "b02 b20",
                   "b02 b20 b01", "b02 b20 b01 p1", "", "", "", "", "",
                   "p2", "", "", "")),
+    Mutant("ack_without_persist", AckWithoutPersist,
+           "sim/step.py phase_d AE_REQ entry-walk room clause (~df fold)",
+           "commit_durability",
+           _b(ticks=6, max_dead=0, max_disk=1, log_cap=4,
+              compact_every=2, max_index=5),
+           "entries storage rejected are acked — fsync skipped",
+           _sched(2, "p0", "", "", "", "d1")),
+    Mutant("commit_past_durable", CommitPastDurable,
+           "sim/step.py phase_a commit tally (match_index, not next_index)",
+           "commit_durability",
+           _b(ticks=3, max_dead=0, log_cap=4, compact_every=2,
+              max_index=5),
+           "send pointer tallied as replicated — commit precedes acks",
+           _sched(2, "p0", "")),
+    Mutant("shed_then_apply", ShedThenApply,
+           "clients/workload.py admission shed gate (definitive reject)",
+           "client_safety",
+           _b(sessions=True, admission=True, ticks=6, max_dead=0),
+           "shed arrival proposed anyway — reject was not definitive",
+           _sched(2, "p0", "", "s0", "", "")),
     Mutant("always_effective", AlwaysEffective,
            "sim/step.py session dedup fold (seq <= table entry skip)",
            "state_machine_digest",

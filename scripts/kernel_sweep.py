@@ -183,52 +183,77 @@ def run_universe(cfg: RaftConfig, n_groups: int, ticks: int,
 
 def nemesis_cell(base_seed: int, n_groups: int, ticks: int,
                  interpret: bool, devices: int = 1) -> int:
-    """The --nemesis smoke cell (ISSUE r14): ONE canonical gray-failure
-    program (`nemesis.gray_mix` — slow-but-alive follower + asymmetric
-    flaky link) through ALL THREE engines over a faulted universe:
+    """The --nemesis cells (ISSUE r14, grown r20): canonical nemesis
+    programs through ALL THREE engines over a faulted universe —
 
-    - CPU oracle vs the XLA scan, lockstep on the trace surface per
-      node per tick (the first min(8, G) groups — groups are
-      independent and identity is the global group id, so the oracle
-      slice of a larger batched run is exact);
-    - XLA scan vs the Pallas kernel (sharded when --devices > 1) on the
-      FULL State + Metrics pytrees, bit-identical.
+    - `gray-mix`: the r14 fail-SLOW acceptance gate (slow-but-alive
+      follower + asymmetric flaky link);
+    - `disk-full` / `compaction`: each r20 storage-pressure clause
+      kind ALONE, so a parity break blames one schedule evaluator;
+    - `pressure-mix+admission`: the combined §19 program with bounded
+      admission-queue client traffic riding on top — the graceful-
+      degradation path (durable-prefix NACKs, ring backpressure,
+      definitive sheds) exercised end to end with the exactly-once
+      ledger checked.
 
-    rc != 0 on any divergence or safety violation."""
+    Per cell: CPU oracle vs the XLA scan, lockstep on the trace
+    surface per node per tick (the first min(8, G) groups — groups
+    are independent and identity is the global group id, so the
+    oracle slice of a larger batched run is exact); then XLA vs the
+    Pallas kernel (sharded when --devices > 1) on the FULL State +
+    Metrics pytrees, bit-identical. rc != 0 on any divergence or
+    safety violation."""
     from raft_tpu import nemesis
     from raft_tpu.obs.triage import oracle_divergence
 
     ticks = max(ticks, 120)   # the acceptance gate is a >=120-tick soak
-    cfg = RaftConfig(seed=base_seed, k=3, log_cap=8, compact_every=4,
-                     drop_prob=0.03, crash_prob=0.1, crash_epoch=24,
-                     nemesis=nemesis.gray_mix(ticks))
-    print(f"[nemesis] program {nemesis.program_hash(cfg.nemesis)}: "
-          f"{nemesis.describe(cfg.nemesis)}", flush=True)
+    base = dict(seed=base_seed, k=3, log_cap=8, compact_every=4,
+                drop_prob=0.03, crash_prob=0.1, crash_epoch=24)
+    admission = dict(sessions=True, cmds_per_tick=0, client_rate=0.3,
+                     client_slots=2, client_queue_cap=4)
+    cells = (
+        ("gray-mix", RaftConfig(**base, nemesis=nemesis.gray_mix(ticks))),
+        ("disk-full", RaftConfig(**base, nemesis=nemesis.program(
+            nemesis.disk_full_follower(0, ticks, p=0.8, epoch=8)))),
+        ("compaction", RaftConfig(**base, nemesis=nemesis.program(
+            nemesis.compaction_pressure(0, ticks, p=0.5, epoch=8)))),
+        ("pressure-mix+admission",
+         RaftConfig(**base, **admission,
+                    nemesis=nemesis.pressure_mix(ticks))),
+    )
+    rc = 0
+    for name, cfg in cells:
+        print(f"[nemesis:{name}] program "
+              f"{nemesis.program_hash(cfg.nemesis)}: "
+              f"{nemesis.describe(cfg.nemesis)}", flush=True)
+        t0 = time.perf_counter()
+        g_oracle = min(8, n_groups)
+        div = oracle_divergence(cfg, n_groups, ticks,
+                                oracle_groups=g_oracle)
+        if div is not None:
+            print(f"[nemesis:{name}] ORACLE vs XLA DIVERGED at "
+                  f"t={div['tick']} group={div['group']} "
+                  f"node={div['node']} field={div['field']}: "
+                  f"cpu={div['cpu']} jax={div['jax']}", flush=True)
+            rc = 1
+            continue
+        print(f"[nemesis:{name}] oracle == xla per node per tick "
+              f"({g_oracle} groups x {ticks} ticks)", flush=True)
 
-    t0 = time.perf_counter()
-    g_oracle = min(8, n_groups)
-    div = oracle_divergence(cfg, n_groups, ticks, oracle_groups=g_oracle)
-    if div is not None:
-        print(f"[nemesis] ORACLE vs XLA DIVERGED at t={div['tick']} "
-              f"group={div['group']} node={div['node']} "
-              f"field={div['field']}: cpu={div['cpu']} jax={div['jax']}",
+        ok, detail, dt, unsafe = run_universe(cfg, n_groups, ticks,
+                                              interpret, devices)
+        tag = "ok" if ok else "DIVERGED"
+        safe_tag = "ok" if unsafe == 0 else f"VIOLATED({unsafe} groups)"
+        print(f"[nemesis:{name}] xla vs kernel: {tag} safety={safe_tag} "
+              f"— {detail} ({time.perf_counter() - t0:.1f}s total)",
               flush=True)
-        return 1
-    print(f"[nemesis] oracle == xla per node per tick "
-          f"({g_oracle} groups x {ticks} ticks)", flush=True)
-
-    ok, detail, dt, unsafe = run_universe(cfg, n_groups, ticks, interpret,
-                                          devices)
-    tag = "ok" if ok else "DIVERGED"
-    safe_tag = "ok" if unsafe == 0 else f"VIOLATED({unsafe} groups)"
-    print(f"[nemesis] xla vs kernel: {tag} safety={safe_tag} — {detail} "
-          f"({time.perf_counter() - t0:.1f}s total)", flush=True)
-    if ok and unsafe == 0:
-        print(f"[nemesis] gray-failure program bit-identical on "
+        if not (ok and unsafe == 0):
+            rc = 1
+    if rc == 0:
+        print(f"[nemesis] {len(cells)} programs bit-identical on "
               f"oracle/xla/kernel over {n_groups} groups x {ticks} "
               f"ticks", file=sys.stderr)
-        return 0
-    return 1
+    return rc
 
 
 def _reexec_with_host_devices(n_devices: int) -> int:
@@ -269,11 +294,13 @@ def main():
                     "alias_wire) — packed x feature x fault pairwise "
                     "cells, same full State+Metrics bit-identity gate")
     ap.add_argument("--nemesis", action="store_true",
-                    help="run the r14 gray-failure smoke cell instead "
-                    "of the pairwise matrix: ONE canonical nemesis "
-                    "program (slow-follower + flaky-link mix) through "
-                    "oracle, XLA, and the kernel over a >=120-tick "
-                    "faulted universe; rc != 0 on any divergence")
+                    help="run the nemesis cells instead of the "
+                    "pairwise matrix: the canonical gray-failure mix, "
+                    "each r20 storage-pressure kind alone, and the "
+                    "pressure mix with bounded-admission client "
+                    "traffic — each through oracle, XLA, and the "
+                    "kernel over a >=120-tick faulted universe; "
+                    "rc != 0 on any divergence")
     ap.add_argument("--stream", action="store_true",
                     help="run every universe's kernel through the r16 "
                     "cohort scheduler (parallel/cohort.py, "

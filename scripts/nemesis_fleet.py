@@ -1,0 +1,194 @@
+"""Budgeted multi-seed nemesis fleet (ISSUE r20, DESIGN.md §19).
+
+One `nemesis_search.py --corpus` hunt explores the mutation space from
+ONE seed's deterministic draw sequence; a fleet runs MANY seeds into a
+SHARED persisted corpus, so every hunt after the first starts from all
+coverage-novel programs the earlier ones found. This driver is the
+budgeted loop around that: it spawns one child hunt per seed (each its
+own process — one jax runtime per hunt, so a wedged candidate can't
+take the fleet down), then triages what the fleet produced:
+
+- violation artifacts are DEDUPED by their (divergent leaf, tick)
+  signature — a fleet of N seeds finding the same dropped invariant N
+  times is one finding, not N — keeping the reproducer with the
+  fewest clauses per signature;
+- clean hunts are RANKED by their best near-miss score, so the next
+  fleet's attention (more budget, --check-kernel) goes to the seeds
+  closest to the edge.
+
+Everything lands in one JSONL fleet report (one record per hunt + a
+final summary record), next to the artifacts and the corpus dir:
+
+    python scripts/nemesis_fleet.py --seeds 8 --budget 12 \\
+        --groups 16 --ticks 64 --corpus corpus/ --report fleet.jsonl
+
+rc 3 if any hunt found a real violation (the deduped artifacts are the
+findings), rc 1 if a child died abnormally, rc 0 on a clean fleet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # runnable as `python scripts/...`
+
+_SEARCH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "nemesis_search.py")
+
+# The child's corpus/score summary line (nemesis_search.py logs it on
+# every clean exit); parsed defensively — a None score just ranks last.
+_SCORE_RE = re.compile(r"best score (-?[\d.]+):")
+
+
+def log(msg: str):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _corpus_size(dirpath: str) -> int:
+    return len(glob.glob(os.path.join(dirpath, "corpus_*.json")))
+
+
+def run_hunt(seed: int, args) -> dict:
+    """One child hunt: its own process, its own artifact path, the
+    SHARED corpus dir. Returns the fleet-report record."""
+    out = os.path.join(args.out_dir, f"NEMESIS_repro_seed{seed}.json")
+    cmd = [sys.executable, _SEARCH, "--seed", str(seed),
+           "--budget", str(args.budget), "--groups", str(args.groups),
+           "--ticks", str(args.ticks), "--corpus", args.corpus,
+           "--out", out]
+    if args.check_kernel:
+        cmd.append("--check-kernel")
+    before = _corpus_size(args.corpus)
+    t0 = time.perf_counter()
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    wall = time.perf_counter() - t0
+    for line in proc.stderr.splitlines():
+        log(f"  [seed {seed}] {line}")
+    m = _SCORE_RE.search(proc.stderr)
+    rec = {"kind": "fleet-hunt", "seed": seed, "rc": proc.returncode,
+           "budget": args.budget, "groups": args.groups,
+           "ticks": args.ticks, "wall_s": round(wall, 2),
+           "best_score": float(m.group(1)) if m else None,
+           "corpus_new": _corpus_size(args.corpus) - before,
+           "artifact": None, "violation": None}
+    if proc.returncode == 3 and os.path.exists(out):
+        with open(out) as fh:
+            art = json.load(fh)
+        rec["artifact"] = out
+        rec["violation"] = {"tick": art["violation"]["tick"],
+                            "leaf": art["violation"]["leaf"],
+                            "program_hash": art["program_hash"],
+                            "clauses": len(art["program"])}
+    elif proc.returncode not in (0, 3):
+        rec["stderr_tail"] = proc.stderr.splitlines()[-5:]
+    return rec
+
+
+def triage(records: list) -> dict:
+    """Corpus triage over the fleet's hunt records: dedupe violations
+    by (leaf, tick) — keeping the fewest-clause reproducer per
+    signature — and rank the clean hunts by best near-miss score."""
+    by_sig: dict = {}
+    for r in records:
+        v = r["violation"]
+        if v is None:
+            continue
+        key = (v["leaf"], v["tick"])
+        cur = by_sig.get(key)
+        if cur is None or v["clauses"] < cur["violation"]["clauses"]:
+            by_sig[key] = r
+    ranked = sorted((r for r in records if r["best_score"] is not None),
+                    key=lambda r: -r["best_score"])
+    return {
+        "kind": "fleet-summary",
+        "hunts": len(records),
+        "violations_total": sum(1 for r in records if r["violation"]),
+        "violations_unique": len(by_sig),
+        "unique_violations": [
+            {"leaf": leaf, "tick": tick,
+             "seed": r["seed"], "artifact": r["artifact"],
+             "program_hash": r["violation"]["program_hash"],
+             "clauses": r["violation"]["clauses"]}
+            for (leaf, tick), r in sorted(by_sig.items())],
+        "ranked_seeds": [{"seed": r["seed"],
+                          "best_score": r["best_score"]}
+                         for r in ranked],
+        "child_failures": [r["seed"] for r in records
+                           if r["rc"] not in (0, 3)],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=4,
+                    help="fleet size: hunts run seeds "
+                         "[--seed-base, --seed-base + N)")
+    ap.add_argument("--seed-base", type=int, default=0)
+    ap.add_argument("--budget", type=int, default=12,
+                    help="mutate-run-score steps PER HUNT (one XLA "
+                         "compile each — the fleet's total compile "
+                         "budget is seeds x budget)")
+    ap.add_argument("--groups", type=int, default=16)
+    ap.add_argument("--ticks", type=int, default=64)
+    ap.add_argument("--corpus", default="nemesis_corpus",
+                    help="SHARED persisted corpus dir: every hunt "
+                         "seeds from it and writes novel programs "
+                         "back, so coverage accumulates across the "
+                         "fleet (and across fleets)")
+    ap.add_argument("--report", default="fleet_report.jsonl",
+                    help="JSONL fleet report: one record per hunt + "
+                         "a final triaged summary record")
+    ap.add_argument("--out-dir", default=".",
+                    help="where per-seed violation artifacts land")
+    ap.add_argument("--check-kernel", action="store_true",
+                    help="pass --check-kernel through to every hunt "
+                         "(slow: one interpret-mode kernel run each)")
+    args = ap.parse_args()
+
+    os.makedirs(args.corpus, exist_ok=True)
+    os.makedirs(args.out_dir, exist_ok=True)
+    seeds = range(args.seed_base, args.seed_base + args.seeds)
+    log(f"fleet: {args.seeds} hunt(s) x budget {args.budget} "
+        f"({args.groups} groups x {args.ticks} ticks per candidate), "
+        f"shared corpus {args.corpus!r} "
+        f"({_corpus_size(args.corpus)} program(s) seeded)")
+    records = []
+    with open(args.report, "a") as rep:
+        for seed in seeds:
+            rec = run_hunt(seed, args)
+            records.append(rec)
+            rep.write(json.dumps(rec, sort_keys=True) + "\n")
+            rep.flush()
+            tag = ("VIOLATION" if rec["violation"]
+                   else "died" if rec["rc"] not in (0, 3) else "clean")
+            log(f"[seed {seed}] {tag} rc={rec['rc']} "
+                f"score={rec['best_score']} "
+                f"corpus+{rec['corpus_new']} ({rec['wall_s']}s)")
+        summary = triage(records)
+        summary["corpus_size"] = _corpus_size(args.corpus)
+        rep.write(json.dumps(summary, sort_keys=True) + "\n")
+    log(f"fleet report -> {args.report}: "
+        f"{summary['violations_total']} violation(s), "
+        f"{summary['violations_unique']} unique by (leaf, tick); "
+        f"corpus {summary['corpus_size']} program(s)")
+    for v in summary["unique_violations"]:
+        log(f"  finding: leaf={v['leaf']!r} tick={v['tick']} "
+            f"program {v['program_hash']} ({v['clauses']} clause(s)) "
+            f"-> {v['artifact']}")
+    if summary["child_failures"]:
+        log(f"  child hunt(s) died abnormally: "
+            f"{summary['child_failures']}")
+        return 1
+    return 3 if summary["violations_unique"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

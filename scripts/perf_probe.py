@@ -102,8 +102,8 @@ def apply_variant(name: str) -> str:
         # only, not all of the apply loop.
         orig_a = ORIG["phase_a"]
 
-        def thin_apply(cfg, ns, i):
-            ns2 = orig_a(cfg, ns, i)
+        def thin_apply(cfg, ns, g, i, t):
+            ns2 = orig_a(cfg, ns, g, i, t)
             return ns2._replace(digest=ns.digest)
         step_mod._phase_a = thin_apply
         return "full"
@@ -111,10 +111,10 @@ def apply_variant(name: str) -> str:
         step_mod._phase_t = lambda cfg, ns, out, g, i, t: (ns, out)
         return "full"
     if name == "nophaseC":
-        step_mod._phase_c = lambda cfg, ns, g, t: ns
+        step_mod._phase_c = lambda cfg, ns, g, i, t, csub=None, cpay=None: ns
         return "full"
     if name == "noapply":
-        def commit_only(cfg, ns, i):
+        def commit_only(cfg, ns, g, i, t):
             from raft_tpu.core.node import LEADER
             from raft_tpu.ops import quorum
             n = quorum.commit_candidate(ns.match_index, ns.last_index, i,

@@ -113,15 +113,22 @@ def test_client_wire_model_pins_exact():
         > pkernel.wire_words_per_group(off)
 
 
-def test_host_workload_mirror_is_exact():
+import pytest
+
+
+@pytest.mark.parametrize("queue_cap", [0, 2])
+def test_host_workload_mirror_is_exact(queue_cap):
     """HostClients (the oracle driver) mirrors the jnp transition bit
     for bit through an adversarial synthetic table-witness schedule —
-    acks, arrivals, retry backoff, backlog, latency events."""
+    acks, arrivals, retry backoff, backlog, latency events, and (r20,
+    cap > 0) the bounded-admission shed ledger."""
+    import dataclasses
+
     import jax.numpy as jnp
     from raft_tpu.clients import client_update, clients_init, \
         submit_payloads
 
-    cfg = CFG
+    cfg = dataclasses.replace(CFG, client_queue_cap=queue_cap)
     g = 0
     cs = clients_init(cfg, 1)
     host = HostClients(cfg, g)
@@ -138,7 +145,11 @@ def test_host_workload_mirror_is_exact():
         cs = client_update(cfg, cs, tm, gcol, scol, t)
         host.observe(tmax_host, t)
         for f in cs._fields:
-            assert list(np.asarray(getattr(cs, f))[0]) \
+            leaf = getattr(cs, f)
+            if leaf is None:   # admission-gated shed leaf, cap off
+                assert f == "shed" and queue_cap == 0, (f, t)
+                continue
+            assert list(np.asarray(leaf)[0]) \
                 == list(getattr(host, f)), (f, t)
         sub, pay = submit_payloads(cfg, cs, gcol, scol)
         assert list(np.asarray(sub)[0]) == host.submit, t
@@ -149,6 +160,10 @@ def test_host_workload_mirror_is_exact():
                                                 host.done[s])))
         assert list(np.asarray(pay)[0]) == want, t
     assert sum(host.retries) > 0 and sum(host.done) > 0
+    if queue_cap:
+        # Not vacuous: load (0.3/tick) outruns the hash-gated ack rate
+        # (~0.2/tick), so the bounded queue genuinely rejected work.
+        assert sum(host.shed) > 0
 
 
 def test_client_safety_latches_double_apply():
@@ -208,6 +223,47 @@ def test_checkpoint_roundtrip_with_clients(tmp_path):
     a, ma = run(CFG, st, 24, 24, m)
     b, mb = run(CFG, st2, 24, t2, m2)
     assert _trees_equal(a, b) and _trees_equal(ma, mb)
+
+
+def test_checkpoint_admission_roundtrip_and_pre_r20_backfill(tmp_path):
+    """r20 checkpoint seams: (a) an admission-on checkpoint round-trips
+    the shed ledger exactly and resumes bit-identically; (b) a
+    synthesized pre-r20 file (no `state.clients.shed` key, no
+    `client_queue_cap` in its config dict) loads under a cap-OFF cfg
+    with shed backfilled to None and the knob backfilled to its
+    default; (c) the same file REFUSES to resume under a cap-ON cfg —
+    admission control changes the trajectory, so silently resuming
+    would splice two different universes."""
+    import dataclasses
+
+    import pytest
+    from raft_tpu.sim import checkpoint
+
+    cfg = dataclasses.replace(CFG, client_queue_cap=2)
+    st, m = run(cfg, sim.init(cfg), 24)
+    assert int(np.asarray(st.clients.shed).sum()) > 0  # non-vacuous
+    path = tmp_path / "admission.npz"
+    checkpoint.save(path, st, 24, m, cfg=cfg)
+    st2, t2, m2 = checkpoint.load(path, cfg=cfg)
+    assert _trees_equal(st, st2) and _trees_equal(m, m2)
+    a, ma = run(cfg, st, 24, 24, m)
+    b, mb = run(cfg, st2, 24, t2, m2)
+    assert _trees_equal(a, b) and _trees_equal(ma, mb)
+    # Synthesize the pre-r20 file: strip the shed leaf and the cfg knob.
+    import json
+
+    with np.load(path) as z:
+        data = {k: z[k] for k in z.files}
+    del data["state.clients.shed"]
+    meta = json.loads(bytes(data["__cfg__"]).decode())
+    del meta["client_queue_cap"]
+    data["__cfg__"] = np.bytes_(json.dumps(meta, sort_keys=True))
+    old = tmp_path / "pre_r20.npz"
+    np.savez(old, **data)
+    st3, _, _ = checkpoint.load(old, cfg=CFG)   # cap-off: backfills
+    assert st3.clients.shed is None
+    with pytest.raises(ValueError):             # cap-on: refuses
+        checkpoint.load(old, cfg=cfg)
 
 
 def test_workload_params_cover_the_knobs():

@@ -28,7 +28,8 @@ BASE = dict(seed=9, k=3, log_cap=8, compact_every=4, drop_prob=0.03,
 
 def _all_kinds_program(ticks: int) -> tuple:
     """One clause of every kind, overlapping spans — the parity tests'
-    worst case (every seam active, every tag drawn)."""
+    worst case (every seam active, every tag drawn). r20 grew it over
+    the storage-pressure seams (disk-full appends, compaction stalls)."""
     return nemesis.program(
         nemesis.slow_follower(0, ticks, p=0.7, direction=3),
         nemesis.flaky_link(0, ticks, p=0.9, burst_epoch=8, burst_p=0.6),
@@ -36,7 +37,9 @@ def _all_kinds_program(ticks: int) -> tuple:
         nemesis.clock_skew(4, ticks - 8, amount=5, node_p=0.6),
         nemesis.crash_storm(8, ticks * 2 // 3, p=0.3, epoch=4),
         nemesis.partition_wave(10, ticks - 4, period=16, width=6,
-                               leak_p=0.8))
+                               leak_p=0.8),
+        nemesis.disk_full_follower(2, ticks - 2, p=0.8, epoch=8),
+        nemesis.compaction_pressure(6, ticks * 3 // 4, p=0.5, epoch=4))
 
 
 # ------------------------------------------------------ compiled form
@@ -58,6 +61,10 @@ def test_nem_evaluator_parity_grids():
     got_alive = np.asarray(jrng.nem_alive(seed, cfg.nem_crash, g, a, t))
     got_extra = np.asarray(jrng.nem_deadline_extra(seed, cfg.nem_skew,
                                                    g, a, t))
+    got_disk = np.asarray(jrng.nem_disk_full(seed, cfg.nem_disk, g, a,
+                                             t, K))
+    got_comp = np.asarray(jrng.nem_compact_block(seed, cfg.nem_compact,
+                                                 g, a, t))
     for ti in range(T):
         for gi in range(G):
             for ai in range(K):
@@ -66,6 +73,12 @@ def test_nem_evaluator_parity_grids():
                 assert int(got_extra[ti, gi, ai, 0]) \
                     == pr.nem_deadline_extra(seed, cfg.nem_skew, gi,
                                              ai, ti)
+                assert bool(got_disk[ti, gi, ai, 0]) \
+                    == pr.nem_disk_full(seed, cfg.nem_disk, gi, ai,
+                                        ti, K)
+                assert bool(got_comp[ti, gi, ai, 0]) \
+                    == pr.nem_compact_block(seed, cfg.nem_compact, gi,
+                                            ai, ti)
                 for bi in range(K):
                     assert bool(got_link[ti, gi, ai, bi]) \
                         == pr.nem_link_ok(seed, cfg.nem_link, gi, ti,
@@ -85,6 +98,10 @@ def test_evaluators_refuse_misfiltered_programs():
         with pytest.raises(ValueError, match="no crash clause"):
             mod.nem_alive(1, nemesis.program(nemesis.wan_delay(0, 8)),
                           0, 0, 0)
+        with pytest.raises(ValueError, match="no disk clause"):
+            mod.nem_disk_full(1, crash_only[0], 0, 0, 0, 3)
+        with pytest.raises(ValueError, match="no compaction clause"):
+            mod.nem_compact_block(1, crash_only[0], 0, 0, 0)
     # ...but a link program whose clauses are all STATIC no-ops (a
     # flaky link in a k=1 group has no links) is legal and passes
     # everything on BOTH evaluators — no engine asymmetry.
@@ -95,13 +112,15 @@ def test_evaluators_refuse_misfiltered_programs():
 
 def test_program_builders_json_hash_and_config_normalization():
     prog = _all_kinds_program(32)
-    # cids are positional and stable; kinds partition across the seams.
-    assert [c.cid for c in prog] == list(range(6))
+    # cids are positional and stable; kinds partition across the seams
+    # (r20: five seams — delivery, liveness, timing, durability,
+    # compaction).
+    assert [c.cid for c in prog] == list(range(8))
     cfg = RaftConfig(**BASE, nemesis=prog)
-    assert set(cfg.nem_link) | set(cfg.nem_crash) | set(cfg.nem_skew) \
-        == set(prog)
-    assert len(cfg.nem_link) + len(cfg.nem_crash) + len(cfg.nem_skew) \
-        == len(prog)
+    seams = (cfg.nem_link, cfg.nem_crash, cfg.nem_skew, cfg.nem_disk,
+             cfg.nem_compact)
+    assert set().union(*(set(s) for s in seams)) == set(prog)
+    assert sum(len(s) for s in seams) == len(prog)
     # JSON round trips: the program alone, and the whole config dict.
     assert nemesis.from_json(nemesis.to_json(prog)) == prog
     assert nemesis.from_json(json.loads(json.dumps(
@@ -166,6 +185,71 @@ def test_gray_mix_xla_vs_kernel_120_ticks():
     assert int((np.asarray(xm.safety) == 0).sum()) == 0
 
 
+def _admission_cfg(ticks: int, **over) -> RaftConfig:
+    """The r20 pressure acceptance universe: the canonical pressure
+    mix (disk-full follower + compaction stalls) with bounded-admission
+    open-loop client traffic riding on top — every new seam active at
+    once (durable-prefix NACKs, ring backpressure, definitive sheds)."""
+    return RaftConfig(**{**BASE, **over}, sessions=True, cmds_per_tick=0,
+                      client_rate=0.3, client_slots=2,
+                      client_queue_cap=4,
+                      nemesis=nemesis.pressure_mix(ticks))
+
+
+def test_pressure_mix_oracle_vs_xla_120_ticks():
+    """Acceptance gate, oracle half (r20): the pressure mix with
+    admission-capped client traffic runs bit-identically on the CPU
+    oracle and the XLA scan, per node per tick, over a >=120-tick
+    faulted universe."""
+    from raft_tpu.obs.triage import oracle_divergence
+
+    ticks = 120
+    cfg = _admission_cfg(ticks)
+    assert oracle_divergence(cfg, 8, ticks, oracle_groups=4) is None
+
+
+def test_pressure_mix_xla_vs_kernel_48_ticks():
+    """Acceptance gate, kernel half (r20, smoke shape): pressure mix +
+    bounded admission bit-identical between the XLA scan and the
+    interpret-mode Pallas kernel on FULL State + Metrics, with the
+    safety fold clean, the shed ledger non-vacuously exercised, and
+    the exactly-once endpoint accounting (shed included) clean."""
+    from raft_tpu.clients import exactly_once_report
+
+    ticks, G = 48, 16
+    cfg = _admission_cfg(ticks)
+    st0 = sim.init(cfg, n_groups=G)
+    xst, xm = run(cfg, st0, ticks, 0, metrics_init(G, clients=True))
+    kst, km = pkernel.prun(cfg, st0, ticks, 0, interpret=True)[:2]
+    assert _trees_equal(xst, kst)
+    assert _trees_equal(xm, km)
+    assert int((np.asarray(xm.safety) == 0).sum()) == 0
+    assert int(np.asarray(xst.clients.shed).sum()) > 0, \
+        "no sheds — the admission differential is vacuous"
+    ok, why = exactly_once_report(cfg, xst, xm)
+    assert ok, why
+
+
+@pytest.mark.slow
+def test_pressure_mix_xla_vs_kernel_64_groups_120_ticks():
+    """The full r20 acceptance differential: the faulted 64-group
+    universe under the pressure mix + bounded admission, XLA vs the
+    interpret-mode kernel, bit-identical on FULL State + Metrics."""
+    from raft_tpu.clients import exactly_once_report
+
+    ticks, G = 120, 64
+    cfg = _admission_cfg(ticks)
+    st0 = sim.init(cfg, n_groups=G)
+    xst, xm = run(cfg, st0, ticks, 0, metrics_init(G, clients=True))
+    kst, km = pkernel.prun(cfg, st0, ticks, 0, interpret=True)[:2]
+    assert _trees_equal(xst, kst)
+    assert _trees_equal(xm, km)
+    assert int((np.asarray(xm.safety) == 0).sum()) == 0
+    assert int(np.asarray(xst.clients.shed).sum()) > 0
+    ok, why = exactly_once_report(cfg, xst, xm)
+    assert ok, why
+
+
 def test_default_off_changes_nothing():
     """nemesis=() compiles the byte-identical pre-r14 program: same
     trajectory as a config that never mentions the knob (the cfg-gating
@@ -191,7 +275,7 @@ def test_nemesis_contracts_clean_and_drift_named():
     probs = contracts.nemesis_problems(
         link_kinds=pr.NEM_LINK_KINDS + (pr.NEM_STORM,))
     assert any("MORE than one seam" in p for p in probs)
-    probs = contracts.nemesis_problems(kinds=pr.NEM_KINDS + (7,))
+    probs = contracts.nemesis_problems(kinds=pr.NEM_KINDS + (9,))
     assert any("no program.py builder" in p for p in probs)
 
 
